@@ -1,0 +1,27 @@
+"""corda_tpu — a TPU-native distributed-ledger framework.
+
+A from-scratch rebuild of the capabilities of Corda (reference:
+MarioAriasC/corda @ 0.7-SNAPSHOT): a P2P network of nodes, a UTXO ledger with
+contract verification, a resumable multi-party flow framework with
+checkpoint/recovery, durable deduplicated messaging, and notary services for
+transaction-uniqueness consensus.
+
+Architecture: the *control plane* (nodes, flows, notary protocol, messaging)
+is idiomatic host Python; the *data plane* — batched Ed25519 signature
+verification and SHA-256 Merkle hashing on the notary hot path — runs as
+vmap'd JAX/XLA kernels on TPU (corda_tpu.ops), sharded across chips with
+jax.sharding (corda_tpu.parallel), behind a pluggable crypto-provider seam
+with a bit-identical pure-Python CPU path as the conformance oracle.
+
+Package map (layers per SURVEY.md §1):
+  crypto/    L0 host crypto: hashes, keys, composite keys, Merkle proofs, oracle
+  ops/       L0 TPU kernels: fe25519 limb arithmetic, Ed25519 verify, SHA-256
+  models/    L1 ledger data model: states, contracts, transactions
+  flows/     L2/L3 flow framework + library flows (notary, resolve, finality)
+  node/      L4/L5 services, state-machine manager, messaging, notary services
+  parallel/  device-mesh sharding of the verification data plane
+  utils/     canonical serialization, bytes, progress tracking
+  testing/   MockNetwork-style deterministic test infrastructure
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+"""L0 host-side cryptography: hashing, signing, key trees, Merkle proofs."""
+
+from corda_tpu.crypto.hashes import SecureHash  # noqa: F401

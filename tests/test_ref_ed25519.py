@@ -1,0 +1,147 @@
+"""Golden-vector tests for the pure-Python Ed25519 conformance oracle."""
+
+import hashlib
+import os
+
+import pytest
+
+from corda_tpu.crypto import ref_ed25519 as ref
+
+# RFC 8032 §7.1 test vectors (seed, pubkey, msg, sig).
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_sign(seed, pub, msg, sig):
+    seed, pub, msg, sig = (bytes.fromhex(x) for x in (seed, pub, msg, sig))
+    assert ref.public_key(seed) == pub
+    assert ref.sign(seed, msg) == sig
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_verify(seed, pub, msg, sig):
+    pub, msg, sig = (bytes.fromhex(x) for x in (pub, msg, sig))
+    assert ref.verify(pub, msg, sig)
+    # Any single-bit flip in the signature must reject.
+    bad = bytearray(sig)
+    bad[0] ^= 1
+    assert not ref.verify(pub, msg, bytes(bad))
+    assert not ref.verify(pub, msg + b"x", sig)
+
+
+def test_cross_check_against_openssl():
+    """Our signatures verify under OpenSSL and vice versa (canonical cases)."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    rng_seed = hashlib.sha256(b"cross-check").digest()
+    for i in range(20):
+        seed = hashlib.sha256(rng_seed + bytes([i]))
+        sk = Ed25519PrivateKey.from_private_bytes(seed.digest())
+        msg = hashlib.sha256(bytes([i]) + b"msg").digest()  # 32-byte "tx id"
+        ossl_sig = sk.sign(msg)
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        pub = sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        assert ref.public_key(seed.digest()) == pub
+        assert ref.sign(seed.digest(), msg) == ossl_sig
+        assert ref.verify(pub, msg, ossl_sig)
+
+
+def test_malformed_inputs_reject_not_crash():
+    """Malformed sig/key bytes must reject, never raise (SignedTransaction
+    treats both a false and an exception as rejection)."""
+    seed = os.urandom(32)
+    pub = ref.public_key(seed)
+    msg = b"hello"
+    sig = ref.sign(seed, msg)
+    assert ref.verify(pub, msg, sig)
+    assert not ref.verify(pub, msg, b"")
+    assert not ref.verify(pub, msg, sig[:63])
+    assert not ref.verify(pub, msg, sig + b"\x00")
+    assert not ref.verify(b"", msg, sig)
+    assert not ref.verify(b"\xff" * 32, msg, sig)  # y = 2^255-1-ish, likely off-curve
+    assert not ref.verify(pub[:31], msg, sig)
+
+
+def test_s_malleability_accepted():
+    """S >= L is accepted (i2p-eddsa 0.1.0 has no range check) — this is the
+    documented divergence from strict RFC 8032 verifiers like OpenSSL."""
+    seed = os.urandom(32)
+    pub = ref.public_key(seed)
+    msg = os.urandom(32)
+    sig = ref.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    s_mall = s + ref.L
+    assert s_mall < 2 ** 256
+    sig_mall = sig[:32] + int.to_bytes(s_mall, 32, "little")
+    assert ref.verify(pub, msg, sig_mall)
+
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    opub = Ed25519PublicKey.from_public_bytes(pub)
+    with pytest.raises(InvalidSignature):
+        opub.verify(sig_mall, msg)  # OpenSSL is strict; we are ref10-faithful
+
+
+def test_non_canonical_encoding_reduced_silently():
+    """Decompression reduces y mod p silently (ref10 semantics): only
+    y in [0, 19) has a representable non-canonical twin y+p < 2^255."""
+    canonical = int.to_bytes(1, 32, "little")  # the identity point (0, 1)
+    non_canonical = int.to_bytes(1 + ref.P, 32, "little")
+    assert ref.decompress(canonical) == (0, 1)
+    assert ref.decompress(non_canonical) == (0, 1)
+
+
+def test_decompress_rejects_non_residue():
+    # y=2 gives u/v a non-residue on edwards25519.
+    bad = int.to_bytes(2, 32, "little")
+    assert ref.decompress(bad) is None
+
+
+def test_base58_roundtrip():
+    from corda_tpu.crypto import base58
+
+    for data in [b"", b"\x00", b"\x00\x00abc", os.urandom(33), b"hello world"]:
+        assert base58.decode(base58.encode(data)) == data
+    assert base58.encode(b"") == ""
+
+
+def test_secure_hash():
+    from corda_tpu.crypto import SecureHash
+
+    h = SecureHash.sha256(b"abc")
+    assert h.hex() == hashlib.sha256(b"abc").hexdigest()
+    assert SecureHash.parse(h.hex()) == h
+    with pytest.raises(ValueError):
+        SecureHash(b"short")
+    assert h.hash_concat(h).bytes == hashlib.sha256(h.bytes + h.bytes).digest()
