@@ -1,0 +1,7 @@
+"""JAX/XLA kernels — the TPU data plane of corda_tpu.
+
+Batched field arithmetic (fe25519), Ed25519 signature verification
+(ed25519_jax) and SHA-256 Merkle hashing (sha256_jax) replace the sequential
+per-signature JVM loops on the reference's notary hot path (reference:
+core/src/main/kotlin/net/corda/core/transactions/SignedTransaction.kt:83-87).
+"""

@@ -1,0 +1,200 @@
+"""Batched Ed25519 signature verification as a single JAX/XLA graph.
+
+This is the TPU execution backend for the reference's notary hot loop — the
+sequential `for (sig in sigs) EdDSAEngine.verify(...)` at reference:
+core/src/main/kotlin/net/corda/core/transactions/SignedTransaction.kt:83-87
+(engine built at core/.../crypto/CryptoUtilities.kt:63-96) — re-designed as a
+data-parallel kernel: N signatures ride the minor axis of every array and the
+whole verification (point decompression, 256-bit double-scalar multiplication,
+canonical re-encoding, byte compare) is one jit-compiled graph with static
+shapes and `lax.scan` loops.
+
+Semantics are bit-identical to the conformance oracle
+(corda_tpu/crypto/ref_ed25519.py — cofactorless ref10 verify, no S<L range
+check, silent y mod p reduction on decompression, encode-compare against the
+raw R bytes). Golden-vector tests enforce the match.
+
+The SHA-512 challenge h = H(R || A || M) mod L is computed on the host
+(hashlib; messages are short and variable-length — a poor fit for fixed-shape
+XLA, and a few microseconds per signature against a millisecond-scale kernel).
+The elliptic-curve math — ~7700 field multiplies per signature — is where the
+time goes, and it is all on-device int32 vector math.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fe25519 as fe
+from ..crypto import ref_ed25519 as ref
+
+__all__ = ["verify_batch", "precompute_batch", "verify_arrays", "pick_bucket"]
+
+_D = ref.D
+_2D = (2 * ref.D) % ref.P
+_SQRT_M1 = pow(2, (ref.P - 1) // 4, ref.P)
+_L = ref.L
+
+# Base point in extended coordinates as (20, 1) broadcastable constants.
+_BX, _BY = ref.B
+
+
+def _c(x: int):
+    return jnp.asarray(fe.limbs_of_int(x % ref.P), fe.I32)[:, None]
+
+
+_B_EXT = (_c(_BX), _c(_BY), _c(1), _c(_BX * _BY % ref.P))
+_K_D = _c(_D)
+_K_2D = _c(_2D)
+_K_SQRT_M1 = _c(_SQRT_M1)
+_ONE = _c(1)
+
+
+def _ext_add(p, q):
+    """Unified a=-1 twisted-Edwards addition (add-2008-hwcd-3), complete on
+    edwards25519 — no exceptional cases, so SIMD lanes never diverge."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
+    b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
+    c = fe.mul(fe.mul(t1, t2), jnp.broadcast_to(_K_2D, t1.shape))
+    d = fe.mul_small(fe.mul(z1, z2), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def _psel(mask, p, q):
+    return tuple(fe.select(mask, a, b) for a, b in zip(p, q))
+
+
+def _double_scalar_mult_sub(s_bits, h_bits, neg_a):
+    """[s]B + [h](-A) via MSB-first Strauss double-and-add in a lax.scan.
+
+    s may be a full 256-bit integer (no range check — oracle semantics).
+    """
+    batch = s_bits.shape[1:]
+    acc0 = tuple(jnp.broadcast_to(c, (fe.NLIMBS,) + batch)
+                 for c in (_c(0), _ONE, _ONE, _c(0)))
+    b_ext = tuple(jnp.broadcast_to(c, (fe.NLIMBS,) + batch) for c in _B_EXT)
+
+    def step(acc, bits):
+        sb, hb = bits
+        acc = _ext_add(acc, acc)
+        acc = _psel(sb > 0, _ext_add(acc, b_ext), acc)
+        acc = _psel(hb > 0, _ext_add(acc, neg_a), acc)
+        return acc, None
+
+    xs = jnp.stack([s_bits, h_bits], axis=1)  # (256, 2, *batch)
+    acc, _ = jax.lax.scan(step, acc0, xs)
+    return acc
+
+
+@jax.jit
+def verify_arrays(a_limbs, a_sign, r_limbs, r_sign, s_bits, h_bits):
+    """The whole-batch verification graph.
+
+    Args (all int32, batch minor):
+      a_limbs (20, N): low 255 bits of the A encoding (y, possibly >= p)
+      a_sign  (N,):    bit 255 of A
+      r_limbs (20, N): low 255 bits of the R encoding — raw, NOT reduced
+      r_sign  (N,):    bit 255 of R
+      s_bits  (256, N) / h_bits (256, N): scalars, MSB first
+    Returns bool (N,): accept/reject per signature.
+    """
+    one = jnp.broadcast_to(_ONE, a_limbs.shape)
+
+    # --- decompress A (ref10 ge_frombytes semantics) ---
+    y = a_limbs
+    yy = fe.sq(y)
+    u = fe.sub(yy, one)
+    v = fe.add(fe.mul(yy, jnp.broadcast_to(_K_D, yy.shape)), one)
+    v3 = fe.mul(fe.sq(v), v)
+    v7 = fe.mul(fe.sq(v3), v)
+    x = fe.mul(fe.mul(u, v3), fe.pow_p58(fe.mul(u, v7)))
+    vxx = fe.mul(v, fe.sq(x))
+    ok_direct = fe.eq(vxx, u)
+    ok_flip = fe.eq(vxx, fe.neg(u))
+    x = fe.select(ok_flip & ~ok_direct,
+                  fe.mul(x, jnp.broadcast_to(_K_SQRT_M1, x.shape)), x)
+    point_ok = ok_direct | ok_flip
+    parity = fe.freeze(x)[0] & 1
+    x = fe.select(parity != a_sign, fe.neg(x), x)
+
+    # --- R' = [s]B - [h]A ---
+    nx = fe.neg(x)
+    neg_a = (nx, y, one, fe.mul(nx, y))
+    rx, ry, rz, _ = _double_scalar_mult_sub(s_bits, h_bits, neg_a)
+
+    # --- canonical encode R' and compare with the raw R bytes ---
+    zi = fe.inv(rz)
+    xr = fe.freeze(fe.mul(rx, zi))
+    yr = fe.freeze(fe.mul(ry, zi))
+    enc_ok = jnp.all(yr == r_limbs, axis=0) & ((xr[0] & 1) == r_sign)
+    return point_ok & enc_ok
+
+
+def pick_bucket(n: int, buckets=(64, 256, 1024, 4096, 16384)) -> int:
+    """Static batch-size bucket: jit caches one executable per bucket instead
+    of recompiling per request size (p99 protection on the notary path)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return -(-n // buckets[-1]) * buckets[-1]
+
+
+def precompute_batch(pubkeys, msgs, sigs, bucket: int | None = None):
+    """Host-side packing: 32-byte keys + messages + 64-byte sigs -> kernel arrays.
+
+    Computes h = SHA-512(R_enc || A_enc || M) mod L with the ORIGINAL encodings
+    (ref10: the pk bytes go straight into the hash) and pads to the bucket size.
+    """
+    n = len(sigs)
+    b = bucket or pick_bucket(n)
+    pk = np.zeros((b, 32), np.uint8)
+    r_enc = np.zeros((b, 32), np.uint8)
+    s_raw = np.zeros((b, 32), np.uint8)
+    h_raw = np.zeros((b, 32), np.uint8)
+    for i in range(n):
+        pk[i] = np.frombuffer(bytes(pubkeys[i]), np.uint8)
+        sig = bytes(sigs[i])
+        r_enc[i] = np.frombuffer(sig[:32], np.uint8)
+        s_raw[i] = np.frombuffer(sig[32:64], np.uint8)
+        h = int.from_bytes(
+            hashlib.sha512(sig[:32] + bytes(pubkeys[i]) + bytes(msgs[i])).digest(),
+            "little") % _L
+        h_raw[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    a_limbs, a_sign = fe.pack_le_bytes(pk)
+    r_limbs, r_sign = fe.pack_le_bytes(r_enc)
+    return (a_limbs, a_sign, r_limbs, r_sign,
+            fe.scalar_bits_msb(s_raw), fe.scalar_bits_msb(h_raw)), n
+
+
+def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
+    """End-to-end batched verify: returns bool (len(sigs),).
+
+    Malformed inputs (wrong lengths, junk bytes) reject — never raise —
+    matching the reference where verify exceptions surface as rejection
+    (reference: core/.../transactions/SignedTransaction.kt:83-87).
+    """
+    n = len(sigs)
+    ok_shape = np.zeros(n, bool)
+    good = [i for i in range(n)
+            if len(bytes(pubkeys[i])) == 32 and len(bytes(sigs[i])) == 64]
+    if not good:
+        return ok_shape
+    arrays, _ = precompute_batch([pubkeys[i] for i in good],
+                                 [msgs[i] for i in good],
+                                 [sigs[i] for i in good])
+    out = np.asarray(verify_arrays(*arrays))
+    for j, i in enumerate(good):
+        ok_shape[i] = out[j]
+    return ok_shape

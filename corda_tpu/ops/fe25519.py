@@ -1,0 +1,213 @@
+"""Batched GF(2^255-19) arithmetic in int32 limbs for TPU.
+
+This is the field layer under the batched Ed25519 verify kernel
+(corda_tpu/ops/ed25519_jax.py) — the TPU-native replacement for the
+per-signature Java bigint math the reference runs sequentially on the notary hot
+path (reference: core/src/main/kotlin/net/corda/core/transactions/
+SignedTransaction.kt:83-87 via core/.../crypto/CryptoUtilities.kt:90-96).
+
+Representation
+--------------
+A field element is 20 limbs of 13 bits in int32, **limb-major**: an array of
+shape ``(20, *batch)`` so the batch dimension is minor and rides the TPU VPU
+lanes at full width. Values are redundant (any value < 2^260 congruent mod p);
+``freeze`` produces the canonical representative in [0, p).
+
+Why radix 2^13 / int32: TPUs have no native 64-bit multiply and JAX runs
+x64-disabled; 13-bit limbs give products <= 2^26 whose 20-term convolution
+sums stay under 2^31, so everything lives in ordinary int32 lanes. 2^260 ===
+608 (mod p) folds the high half of products back down (608 = 19 * 2^5).
+
+All functions are shape-polymorphic in the batch dims and jit/vmap/shard_map
+friendly (static shapes, no data-dependent control flow).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+RADIX = 13
+NLIMBS = 20
+MASK = (1 << RADIX) - 1
+NCOEF = 2 * NLIMBS - 1  # 39
+P = 2**255 - 19
+FOLD = 608  # 2^260 mod p
+
+I32 = jnp.int32
+
+
+def limbs_of_int(x: int) -> np.ndarray:
+    """Python int (0 <= x < 2^260) -> (20,) int32 limb array (numpy, host)."""
+    if not 0 <= x < 1 << (RADIX * NLIMBS):
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMBS)], np.int32)
+
+
+def int_of_limbs(limbs) -> int:
+    """(20, ...) limb array -> python int(s); host-side test helper."""
+    arr = np.asarray(limbs)
+    return sum(int(arr[i]) << (RADIX * i) for i in range(arr.shape[0]))
+
+
+def const(x: int, batch_shape=()) -> jnp.ndarray:
+    """Broadcast a host integer constant to a (20, *batch) field element."""
+    c = jnp.asarray(limbs_of_int(x % P), I32)
+    return jnp.broadcast_to(c.reshape((NLIMBS,) + (1,) * len(batch_shape)),
+                            (NLIMBS,) + tuple(batch_shape))
+
+
+def _carry(x: jnp.ndarray):
+    """Signed carry propagation along axis 0. Returns (limbs in [0,2^13), carry_out).
+
+    Works for negative inputs: `& MASK` / arithmetic `>> RADIX` implement
+    floor-division semantics in two's complement.
+    """
+    out = []
+    c = jnp.zeros(x.shape[1:], I32)
+    for i in range(x.shape[0]):
+        t = x[i] + c
+        out.append(t & MASK)
+        c = t >> RADIX
+    return jnp.stack(out), c
+
+
+def reduce(x: jnp.ndarray) -> jnp.ndarray:
+    """Weak-reduce an (n, *batch) signed limb array (n in [20, 39]) to 20 limbs
+    in [0, 2^13), value congruent mod p, value < 2^260."""
+    limbs, c = _carry(x)
+    if x.shape[0] > NLIMBS:
+        # Fold limbs at positions >= 20 (weight 2^(260+13k) === 608*2^13k).
+        pad = NCOEF - x.shape[0]
+        high = limbs[NLIMBS:]
+        if pad:
+            high = jnp.concatenate([high, jnp.zeros((pad,) + x.shape[1:], I32)])
+        high = jnp.concatenate([high, c[None]])  # carry sits at position 39
+        v = limbs[:NLIMBS] + FOLD * high
+        limbs, c = _carry(v)
+    # Fold the (possibly negative) carry-out at weight 2^260 twice; the second
+    # pass always lands with zero carry (|c| shrinks by ~2^13 per round).
+    for _ in range(2):
+        v = jnp.concatenate([(limbs[0] + FOLD * c)[None], limbs[1:]])
+        limbs, c = _carry(v)
+    return limbs
+
+
+def add(a, b):
+    return reduce(a + b)
+
+
+def sub(a, b):
+    return reduce(a - b)
+
+
+def neg(a):
+    return reduce(-a)
+
+
+def mul(a, b):
+    """Field multiply. Inputs must be weak-reduced (limbs in [0, 2^13))."""
+    batch = a.shape[1:]
+    acc = jnp.zeros((NCOEF,) + batch, I32)
+    for i in range(NLIMBS):
+        seg = acc[i:i + NLIMBS] + a[i] * b
+        acc = jnp.concatenate([acc[:i], seg, acc[i + NLIMBS:]])
+    return reduce(acc)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small host constant k (k*2^13*20 must fit int32)."""
+    return reduce(a * np.int32(k))
+
+
+def _pow_bits(x, exponent: int):
+    """x^exponent via MSB-first square-and-multiply inside a lax.scan
+    (keeps the XLA graph ~2 muls instead of ~2*255 unrolled)."""
+    bits = [int(b) for b in bin(exponent)[2:]]
+    bits_arr = jnp.asarray(bits[1:], I32)  # leading 1 -> start acc = x
+
+    def step(acc, bit):
+        acc = mul(acc, acc)
+        withx = mul(acc, x)
+        acc = jnp.where(bit > 0, withx, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, x, bits_arr)
+    return acc
+
+
+def inv(a):
+    """a^(p-2); inv(0) = 0 (no division, malformed lanes stay finite)."""
+    return _pow_bits(a, P - 2)
+
+
+def pow_p58(a):
+    """a^((p-5)/8) — the candidate-root exponent for decompression."""
+    return _pow_bits(a, (P - 5) // 8)
+
+
+# Precomputed k*p limb constants for the freeze ladder (k*p < 2^260 for k<=32).
+_KP = {k: jnp.asarray(limbs_of_int(k * P), I32) for k in (32, 16, 8, 4, 2, 1)}
+
+
+def freeze(a):
+    """Canonical representative in [0, p) of a weak-reduced element.
+
+    Binary ladder of conditional subtractions: value < 2^260 < 64p, so
+    subtracting k*p for k = 32,16,...,1 whenever value >= k*p lands in [0,p).
+    """
+    v = a
+    batch_nd = a.ndim - 1
+    for k in (32, 16, 8, 4, 2, 1):
+        kp = _KP[k].reshape((NLIMBS,) + (1,) * batch_nd)
+        d, c = _carry(v - kp)
+        v = jnp.where((c < 0)[None], v, d)
+    return v
+
+
+def is_zero(a):
+    """Boolean batch mask: a === 0 (mod p). Input weak-reduced."""
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def eq(a, b):
+    return is_zero(sub(a, b))
+
+
+def select(mask, a, b):
+    """Per-lane select: mask has batch shape, a/b are field elements."""
+    return jnp.where(mask[None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers (numpy; feed the kernel from 32-byte encodings)
+# ---------------------------------------------------------------------------
+
+_LIMB_WEIGHTS = (1 << np.arange(RADIX, dtype=np.int64)).astype(np.int32)
+
+
+def pack_le_bytes(enc: np.ndarray):
+    """(N, 32) uint8 little-endian encodings -> (limbs (20, N) int32 of the low
+    255 bits, sign (N,) int32 of bit 255). Vectorized, no Python ints."""
+    enc = np.ascontiguousarray(enc, np.uint8)
+    bits = np.unpackbits(enc, axis=1, bitorder="little")  # (N, 256)
+    sign = bits[:, 255].astype(np.int32)
+    low = np.concatenate(
+        [bits[:, :255], np.zeros((enc.shape[0], NLIMBS * RADIX - 255), np.uint8)],
+        axis=1,
+    )
+    limbs = low.reshape(-1, NLIMBS, RADIX).astype(np.int32) @ _LIMB_WEIGHTS
+    return limbs.T.copy(), sign
+
+
+def scalar_bits_msb(raw: np.ndarray):
+    """(N, 32) uint8 little-endian scalars -> (256, N) int32 bits, MSB first."""
+    bits = np.unpackbits(np.ascontiguousarray(raw, np.uint8), axis=1,
+                         bitorder="little")
+    return bits[:, ::-1].T.astype(np.int32).copy()

@@ -2,14 +2,22 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the JAX kernels are backend-neutral; the CPU
-backend is the conformance twin of the TPU path). Must run before jax import.
+backend is the conformance twin of the TPU path).
+
+Note: this host's axon sitecustomize force-registers the TPU backend and
+overrides JAX_PLATFORMS at interpreter start, so the env var alone is not
+enough — we must also update jax.config after import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

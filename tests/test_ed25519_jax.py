@@ -1,0 +1,152 @@
+"""Golden-vector conformance: the JAX kernel vs the Python oracle.
+
+Every case asserts kernel(x) == oracle(x) — the oracle
+(corda_tpu/crypto/ref_ed25519.py) defines the authoritative accept set
+matching the reference's EdDSAEngine behaviour (reference:
+core/src/main/kotlin/net/corda/core/crypto/CryptoUtilities.kt:90-96).
+"""
+
+import numpy as np
+import pytest
+
+from corda_tpu.crypto import ref_ed25519 as ref
+from corda_tpu.ops import ed25519_jax as kernel
+
+rng = np.random.default_rng(99)
+
+
+def _keypair(i):
+    seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    return seed, ref.public_key(seed)
+
+
+def _flip(b: bytes, idx: int, bit: int = 1) -> bytes:
+    out = bytearray(b)
+    out[idx] ^= bit
+    return bytes(out)
+
+
+def _run(cases):
+    """cases: list of (pk, msg, sig). Assert kernel matches oracle per case."""
+    pks = [c[0] for c in cases]
+    msgs = [c[1] for c in cases]
+    sigs = [c[2] for c in cases]
+    got = kernel.verify_batch(pks, msgs, sigs)
+    want = [ref.verify(pk, m, s) for pk, m, s in cases]
+    assert got.tolist() == want, list(zip(got.tolist(), want))
+    return want
+
+
+def test_valid_signatures_accept():
+    cases = []
+    for i in range(8):
+        seed, pk = _keypair(i)
+        msg = bytes(rng.integers(0, 256, int(rng.integers(0, 200)), dtype=np.uint8))
+        cases.append((pk, msg, ref.sign(seed, msg)))
+    want = _run(cases)
+    assert all(want)  # sanity: oracle accepts its own signatures
+
+
+def test_corruptions_reject_and_match_oracle():
+    seed, pk = _keypair(0)
+    msg = b"notarise me"
+    sig = ref.sign(seed, msg)
+    cases = [
+        (pk, msg, sig),                       # control: valid
+        (pk, msg + b"x", sig),                # message tampered
+        (pk, msg, _flip(sig, 0)),             # R corrupted
+        (pk, msg, _flip(sig, 40)),            # S corrupted
+        (_flip(pk, 3), msg, sig),             # pubkey corrupted
+        (pk, b"", sig),                       # wrong (empty) message
+        (pk, msg, _flip(sig, 63, 0x80)),      # S high bit set (s >= 2^255)
+    ]
+    want = _run(cases)
+    assert want[0] is True and not any(want[1:])
+
+
+def test_s_plus_L_accepted_no_range_check():
+    # The era's library does not range-check S: s+L verifies the same point.
+    seed, pk = _keypair(1)
+    msg = b"malleable"
+    sig = ref.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    s2 = s + ref.L
+    assert s2 < 1 << 256
+    sig2 = sig[:32] + s2.to_bytes(32, "little")
+    want = _run([(pk, msg, sig2)])
+    assert want == [True]
+
+
+def _small_y_point():
+    """A curve point with y < 19, so y+p still fits in 255 bits."""
+    for y in range(19):
+        x = ref._recover_x(y, 0)
+        if x is not None:
+            return (x, y)
+    raise AssertionError("no small-y point found")
+
+
+def test_noncanonical_A_encoding_matches_oracle():
+    # y >= p in the pubkey encoding: decompression silently reduces mod p.
+    pt = _small_y_point()
+    pk_canon = ref.compress(pt)
+    n = int.from_bytes(pk_canon, "little")
+    pk_noncanon = int.to_bytes(n + ref.P, 32, "little")
+    msg = b"m"
+    # No private key for this point; craft an (invalid) signature and just
+    # require kernel == oracle on both encodings.
+    sig = bytes(64)
+    _run([(pk_canon, msg, sig), (pk_noncanon, msg, sig)])
+
+
+def test_noncanonical_R_rejected_by_byte_compare():
+    seed, pk = _keypair(2)
+    msg = b"R games"
+    sig = ref.sign(seed, msg)
+    r = int.from_bytes(sig[:32], "little")
+    if (r & ((1 << 255) - 1)) < 19:  # astronomically unlikely; guard anyway
+        pytest.skip("R is a small-y encoding")
+    # Perturb R to a non-canonical encoding of the SAME point where possible
+    # is not generally doable; instead check that an R with y >= p rejects.
+    pt = _small_y_point()
+    bad_r = int.to_bytes(int.from_bytes(ref.compress(pt), "little") + ref.P,
+                         32, "little")
+    sig2 = bad_r + sig[32:]
+    want = _run([(pk, msg, sig2)])
+    assert want == [False]
+
+
+def test_invalid_point_rejects():
+    # Find a y that is not on the curve.
+    for y in range(2, 100):
+        if ref._recover_x(y, 0) is None:
+            bad_pk = int.to_bytes(y, 32, "little")
+            break
+    seed, pk = _keypair(3)
+    msg = b"x"
+    sig = ref.sign(seed, msg)
+    want = _run([(bad_pk, msg, sig)])
+    assert want == [False]
+
+
+def test_wrong_lengths_reject_without_raising():
+    seed, pk = _keypair(4)
+    msg = b"len"
+    sig = ref.sign(seed, msg)
+    got = kernel.verify_batch([pk[:31], pk, pk], [msg, msg, msg],
+                              [sig, sig[:63], sig])
+    assert got.tolist() == [False, False, True]
+
+
+def test_mixed_large_batch():
+    cases = []
+    for i in range(40):
+        seed, pk = _keypair(i)
+        msg = bytes([i]) * (i % 7)
+        sig = ref.sign(seed, msg)
+        if i % 3 == 1:
+            sig = _flip(sig, i % 64)
+        if i % 5 == 2:
+            msg = msg + b"!"
+        cases.append((pk, msg, sig))
+    _run(cases)

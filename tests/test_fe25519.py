@@ -1,0 +1,117 @@
+"""fe25519 limb arithmetic vs Python big-int ground truth."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from corda_tpu.ops import fe25519 as fe
+
+P = fe.P
+rng = np.random.default_rng(1234)
+
+
+def rand_ints(n):
+    return [int.from_bytes(rng.bytes(33), "little") % (1 << 260) for _ in range(n)]
+
+
+def batch_of(vals):
+    """list of python ints -> (20, N) device array."""
+    return jnp.asarray(np.stack([fe.limbs_of_int(v) for v in vals], axis=1))
+
+
+def as_ints(limbs):
+    arr = np.asarray(limbs)
+    return [fe.int_of_limbs(arr[:, j]) for j in range(arr.shape[1])]
+
+
+EDGE = [0, 1, 2, 19, P - 1, P, P + 1, 2 * P, (1 << 255) - 1, (1 << 260) - 1,
+        fe.FOLD, P - 19]
+
+
+def test_roundtrip_limbs():
+    vals = EDGE + rand_ints(20)
+    assert as_ints(batch_of(vals)) == vals
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (fe.add, lambda a, b: (a + b) % P),
+    (fe.sub, lambda a, b: (a - b) % P),
+    (fe.mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    avals = EDGE + rand_ints(20)
+    bvals = rand_ints(len(EDGE)) + EDGE + rand_ints(8)
+    a, b = batch_of(avals), batch_of(bvals[: len(avals)])
+    got = as_ints(op(a, b))
+    for g, x, y in zip(got, avals, bvals):
+        assert g % P == pyop(x, y), (x, y)
+        assert 0 <= g < 1 << 260
+
+
+def test_mul_inputs_must_be_weak_reduced_contract():
+    # mul requires limbs in [0, 2^13); reduce() establishes that.
+    vals = rand_ints(8)
+    a = fe.reduce(batch_of(vals) * 1)  # already canonical limbs
+    assert np.asarray(a).max() < 1 << 13
+
+
+def test_neg_and_reduce_signed():
+    vals = EDGE + rand_ints(10)
+    a = batch_of(vals)
+    got = as_ints(fe.neg(a))
+    for g, x in zip(got, vals):
+        assert g % P == (-x) % P
+
+
+def test_freeze_canonical():
+    vals = EDGE + rand_ints(20)
+    frozen = as_ints(fe.freeze(batch_of(vals)))
+    for f, x in zip(frozen, vals):
+        assert f == x % P
+
+
+def test_inv():
+    vals = [1, 2, P - 1] + rand_ints(5)
+    a = batch_of(vals)
+    got = as_ints(fe.freeze(fe.inv(a)))
+    for g, x in zip(got, vals):
+        assert g == pow(x, P - 2, P)
+
+
+def test_inv_zero_is_zero():
+    assert as_ints(fe.freeze(fe.inv(batch_of([0]))))[0] == 0
+
+
+def test_pow_p58():
+    vals = rand_ints(5)
+    got = as_ints(fe.freeze(fe.pow_p58(batch_of(vals))))
+    for g, x in zip(got, vals):
+        assert g == pow(x, (P - 5) // 8, P)
+
+
+def test_is_zero_eq():
+    a = batch_of([0, P, 5, 2 * P])
+    assert np.asarray(fe.is_zero(a)).tolist() == [True, True, False, True]
+    b = batch_of([P, 0, 5 + P, 7])
+    assert np.asarray(fe.eq(a, b)).tolist() == [True, True, True, False]
+
+
+def test_pack_le_bytes():
+    raw = rng.integers(0, 256, (16, 32), dtype=np.uint8)
+    limbs, sign = fe.pack_le_bytes(raw)
+    for j in range(16):
+        n = int.from_bytes(raw[j].tobytes(), "little")
+        assert fe.int_of_limbs(limbs[:, j]) == n & ((1 << 255) - 1)
+        assert sign[j] == n >> 255
+
+
+def test_scalar_bits_msb():
+    raw = rng.integers(0, 256, (4, 32), dtype=np.uint8)
+    bits = fe.scalar_bits_msb(raw)
+    for j in range(4):
+        n = int.from_bytes(raw[j].tobytes(), "little")
+        got = 0
+        for i in range(256):
+            got = (got << 1) | int(bits[i, j])
+        assert got == n
