@@ -25,3 +25,5 @@ Package map (layers per SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+from .serialization import wire as _wire  # noqa: E402,F401  (whitelist core types)

@@ -1,3 +1,29 @@
 """L0 host-side cryptography: hashing, signing, key trees, Merkle proofs."""
 
-from corda_tpu.crypto.hashes import SecureHash  # noqa: F401
+from .hashes import SecureHash  # noqa: F401
+from .keys import (  # noqa: F401
+    DigitalSignature,
+    KeyPair,
+    NULL_PUBLIC_KEY,
+    NULL_SIGNATURE,
+    PrivateKey,
+    PublicKey,
+    SignatureError,
+    by_keys,
+)
+from .composite import (  # noqa: F401
+    CompositeKey,
+    CompositeKeyLeaf,
+    CompositeKeyNode,
+    all_keys,
+)
+from .merkle import (  # noqa: F401
+    MerkleDuplicatedLeaf,
+    MerkleLeaf,
+    MerkleNode,
+    MerkleTree,
+    MerkleTreeException,
+    PartialMerkleTree,
+)
+from .party import Party, PartyAndReference  # noqa: F401
+from .signed_data import SignedData  # noqa: F401

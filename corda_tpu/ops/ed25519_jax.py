@@ -24,7 +24,6 @@ time goes, and it is all on-device int32 vector math.
 from __future__ import annotations
 
 import hashlib
-from functools import partial
 
 import numpy as np
 

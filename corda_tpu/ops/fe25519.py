@@ -80,11 +80,12 @@ def reduce(x: jnp.ndarray) -> jnp.ndarray:
     limbs, c = _carry(x)
     if x.shape[0] > NLIMBS:
         # Fold limbs at positions >= 20 (weight 2^(260+13k) === 608*2^13k).
+        # Carry out of an n-limb input has weight 2^(13n): it sits right after
+        # limbs[20:n] in the folded vector, before any zero padding.
         pad = NCOEF - x.shape[0]
-        high = limbs[NLIMBS:]
+        high = jnp.concatenate([limbs[NLIMBS:], c[None]])
         if pad:
             high = jnp.concatenate([high, jnp.zeros((pad,) + x.shape[1:], I32)])
-        high = jnp.concatenate([high, c[None]])  # carry sits at position 39
         v = limbs[:NLIMBS] + FOLD * high
         limbs, c = _carry(v)
     # Fold the (possibly negative) carry-out at weight 2^260 twice; the second

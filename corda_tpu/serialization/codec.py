@@ -1,0 +1,318 @@
+"""Canonical, deterministic, whitelisted binary serialization.
+
+Capability match for the reference's Kryo layer (reference:
+core/src/main/kotlin/net/corda/core/serialization/Kryo.kt:41-507): one format
+serves the wire protocol, transaction-component hashing and flow checkpoints,
+with a registration whitelist so deserialization can never instantiate
+unexpected classes (the reference gets this from registered Kryo serializers
+and its attack-surface notes).
+
+Unlike Kryo this format is *canonical by construction* — a value has exactly
+one encoding — because transaction ids are Merkle roots over serialized
+components (reference: core/.../transactions/WireTransaction.kt:45-52,
+MerkleTransaction.kt:26-38) and must be stable across processes, hosts and
+framework versions. Design:
+
+  tag byte, then payload:
+    0x00 None        0x01 False        0x02 True
+    0x03 int         zigzag varint (arbitrary precision)
+    0x04 bytes       varint length + raw
+    0x05 str         varint length + utf-8
+    0x06 list/tuple  varint count + items
+    0x07 dict        varint count + alternating key/value, entries sorted by
+                     encoded key (canonical regardless of insertion order)
+    0x08 object      registered type name (str payload) + varint field count
+                     + field values in dataclass field order
+    0x09 frozenset   varint count + items sorted by their encodings
+
+Dataclasses register with `@register` (or `register_class`); the registry maps
+a stable wire name to the class. Deserializing an unregistered name raises
+DeserializationError — the whitelist seam that mirrors the reference's
+controlled Kryo registration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Callable, Type, TypeVar
+
+T = TypeVar("T")
+
+_TAG_NONE = 0x00
+_TAG_FALSE = 0x01
+_TAG_TRUE = 0x02
+_TAG_INT = 0x03
+_TAG_BYTES = 0x04
+_TAG_STR = 0x05
+_TAG_LIST = 0x06
+_TAG_DICT = 0x07
+_TAG_OBJECT = 0x08
+_TAG_FROZENSET = 0x09
+
+
+class DeserializationError(Exception):
+    pass
+
+
+_BY_NAME: dict[str, type] = {}
+_BY_TYPE: dict[type, str] = {}
+_CUSTOM_ENC: dict[type, Callable[[Any], tuple]] = {}
+_CUSTOM_DEC: dict[str, Callable[[tuple], Any]] = {}
+
+
+def register_class(
+    cls: Type[T],
+    name: str | None = None,
+    encode: Callable[[Any], tuple] | None = None,
+    decode: Callable[[tuple], Any] | None = None,
+) -> Type[T]:
+    """Whitelist a class for serialization.
+
+    Dataclasses need no encode/decode: their fields (in declaration order) are
+    the wire representation. Other classes supply encode (instance -> tuple of
+    serializable values) and decode (tuple -> instance).
+    """
+    wire_name = name or f"{cls.__module__.removeprefix('corda_tpu.')}.{cls.__qualname__}"
+    if wire_name in _BY_NAME and _BY_NAME[wire_name] is not cls:
+        raise ValueError(f"wire name {wire_name!r} already registered")
+    _BY_NAME[wire_name] = cls
+    _BY_TYPE[cls] = wire_name
+    if encode is not None:
+        _CUSTOM_ENC[cls] = encode
+    if decode is not None:
+        _CUSTOM_DEC[wire_name] = decode
+    elif not dataclasses.is_dataclass(cls):
+        raise ValueError(f"{cls} is not a dataclass; provide encode/decode")
+    return cls
+
+
+def register(cls: Type[T]) -> Type[T]:
+    """Decorator form of register_class for dataclasses."""
+    return register_class(cls)
+
+
+def _write_varint(out: bytearray, n: int) -> None:
+    if n < 0:
+        raise ValueError("varint must be non-negative")
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        if pos >= len(data):
+            raise DeserializationError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> (n.bit_length() + 1)) if n < 0 else n << 1
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _encode(out: bytearray, value: Any) -> None:
+    if value is None:
+        out.append(_TAG_NONE)
+    elif value is False:
+        out.append(_TAG_FALSE)
+    elif value is True:
+        out.append(_TAG_TRUE)
+    elif isinstance(value, int):
+        out.append(_TAG_INT)
+        _write_varint(out, _zigzag(value))
+    elif isinstance(value, bytes):
+        out.append(_TAG_BYTES)
+        _write_varint(out, len(value))
+        out.extend(value)
+    elif isinstance(value, str):
+        raw = value.encode("utf-8")
+        out.append(_TAG_STR)
+        _write_varint(out, len(raw))
+        out.extend(raw)
+    elif isinstance(value, (list, tuple)):
+        out.append(_TAG_LIST)
+        _write_varint(out, len(value))
+        for item in value:
+            _encode(out, item)
+    elif isinstance(value, dict):
+        entries = []
+        for k, v in value.items():
+            kbuf = bytearray()
+            _encode(kbuf, k)
+            vbuf = bytearray()
+            _encode(vbuf, v)
+            entries.append((bytes(kbuf), bytes(vbuf)))
+        entries.sort()  # canonical: equal dicts encode identically
+        out.append(_TAG_DICT)
+        _write_varint(out, len(entries))
+        for kenc, venc in entries:
+            out.extend(kenc)
+            out.extend(venc)
+    elif isinstance(value, frozenset):
+        encs = []
+        for item in value:
+            buf = bytearray()
+            _encode(buf, item)
+            encs.append(bytes(buf))
+        encs.sort()
+        out.append(_TAG_FROZENSET)
+        _write_varint(out, len(encs))
+        for e in encs:
+            out.extend(e)
+    else:
+        cls = type(value)
+        wire_name = _BY_TYPE.get(cls)
+        if wire_name is None:
+            raise TypeError(f"type {cls.__qualname__} is not registered for serialization")
+        enc = _CUSTOM_ENC.get(cls)
+        if enc is not None:
+            fields = tuple(enc(value))
+        else:
+            fields = tuple(
+                getattr(value, f.name) for f in dataclasses.fields(value)
+            )
+        out.append(_TAG_OBJECT)
+        raw = wire_name.encode("utf-8")
+        _write_varint(out, len(raw))
+        out.extend(raw)
+        _write_varint(out, len(fields))
+        for f in fields:
+            _encode(out, f)
+
+
+def _decode(data: bytes, pos: int) -> tuple[Any, int]:
+    if pos >= len(data):
+        raise DeserializationError("truncated data")
+    tag = data[pos]
+    pos += 1
+    if tag == _TAG_NONE:
+        return None, pos
+    if tag == _TAG_FALSE:
+        return False, pos
+    if tag == _TAG_TRUE:
+        return True, pos
+    if tag == _TAG_INT:
+        n, pos = _read_varint(data, pos)
+        return _unzigzag(n), pos
+    if tag == _TAG_BYTES:
+        n, pos = _read_varint(data, pos)
+        if pos + n > len(data):
+            raise DeserializationError("truncated bytes")
+        return data[pos : pos + n], pos + n
+    if tag == _TAG_STR:
+        n, pos = _read_varint(data, pos)
+        if pos + n > len(data):
+            raise DeserializationError("truncated string")
+        return data[pos : pos + n].decode("utf-8"), pos + n
+    if tag == _TAG_LIST:
+        n, pos = _read_varint(data, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode(data, pos)
+            items.append(item)
+        return tuple(items), pos
+    if tag == _TAG_DICT:
+        n, pos = _read_varint(data, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _decode(data, pos)
+            v, pos = _decode(data, pos)
+            d[k] = v
+        return d, pos
+    if tag == _TAG_FROZENSET:
+        n, pos = _read_varint(data, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode(data, pos)
+            items.append(item)
+        return frozenset(items), pos
+    if tag == _TAG_OBJECT:
+        n, pos = _read_varint(data, pos)
+        wire_name = data[pos : pos + n].decode("utf-8")
+        pos += n
+        cls = _BY_NAME.get(wire_name)
+        if cls is None:
+            raise DeserializationError(f"type {wire_name!r} is not whitelisted")
+        nfields, pos = _read_varint(data, pos)
+        values = []
+        for _ in range(nfields):
+            v, pos = _decode(data, pos)
+            values.append(v)
+        dec = _CUSTOM_DEC.get(wire_name)
+        if dec is not None:
+            return dec(tuple(values)), pos
+        flds = dataclasses.fields(cls)
+        if len(values) != len(flds):
+            raise DeserializationError(
+                f"{wire_name}: expected {len(flds)} fields, got {len(values)}"
+            )
+        kwargs = {}
+        for f, v in zip(flds, values):
+            # Tuples are the wire form of all sequences; convert back per the
+            # declared field so list-typed fields round-trip.
+            if isinstance(v, tuple) and str(f.type).startswith(("list", "List")):
+                v = list(v)
+            kwargs[f.name] = v
+        try:
+            return cls(**kwargs), pos
+        except Exception as e:  # malformed payloads must not crash callers
+            raise DeserializationError(f"cannot construct {wire_name}: {e}") from e
+    raise DeserializationError(f"unknown tag 0x{tag:02x}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SerializedBytes:
+    """A typed wrapper over a serialized blob (reference: Kryo.kt:76-81)."""
+
+    bytes: bytes
+
+    @property
+    def hash(self):
+        from ..crypto.hashes import SecureHash
+
+        return SecureHash.sha256(self.bytes)
+
+    def deserialize(self) -> Any:
+        return deserialize(self.bytes)
+
+    def __len__(self) -> int:
+        return len(self.bytes)
+
+
+def serialize(value: Any) -> SerializedBytes:
+    out = bytearray()
+    _encode(out, value)
+    return SerializedBytes(bytes(out))
+
+
+def deserialize(data: bytes | SerializedBytes) -> Any:
+    raw = data.bytes if isinstance(data, SerializedBytes) else data
+    value, pos = _decode(raw, 0)
+    if pos != len(raw):
+        raise DeserializationError(f"{len(raw) - pos} trailing bytes")
+    return value
+
+
+def serialized_hash(value: Any):
+    """Hash of the canonical serialization — the Merkle leaf function
+    (reference: MerkleTransaction.kt:35-38)."""
+    from ..crypto.hashes import SecureHash
+
+    return SecureHash(hashlib.sha256(serialize(value).bytes).digest())

@@ -1,0 +1,3 @@
+"""Utility primitives: opaque byte wrappers, progress tracking, misc."""
+
+from .bytes import OpaqueBytes  # noqa: F401

@@ -1,0 +1,211 @@
+"""Host crypto layer: keys, composite keys, Merkle/partial-Merkle, SignedData.
+
+Mirrors the reference's CompositeKeyTests and PartialMerkleTreeTest coverage
+(reference: core/src/test/kotlin/net/corda/core/crypto/CompositeKeyTests.kt,
+PartialMerkleTreeTest.kt) against the new implementations.
+"""
+
+import pytest
+
+from corda_tpu.crypto import (
+    CompositeKey,
+    DigitalSignature,
+    KeyPair,
+    MerkleTree,
+    MerkleTreeException,
+    PartialMerkleTree,
+    Party,
+    SecureHash,
+    SignatureError,
+    SignedData,
+)
+from corda_tpu.serialization.codec import serialize, deserialize
+
+
+def kp(i: int) -> KeyPair:
+    return KeyPair.generate(bytes([i]) * 32)
+
+
+ALICE, BOB, CHARLIE = kp(1), kp(2), kp(3)
+
+
+class TestKeys:
+    def test_sign_verify_roundtrip(self):
+        sig = ALICE.sign(b"hello")
+        sig.verify(b"hello")
+        assert sig.is_valid(b"hello")
+        assert not sig.is_valid(b"goodbye")
+
+    def test_bad_signature_raises(self):
+        sig = ALICE.sign(b"hello")
+        with pytest.raises(SignatureError):
+            sig.verify(b"other")
+
+    def test_sign_as_party(self):
+        party = Party.of("Alice Corp", ALICE.public)
+        sig = ALICE.sign_as(b"data", party)
+        assert sig.signer == party
+        sig.verify(b"data")
+
+    def test_sign_as_wrong_party_rejected(self):
+        party = Party.of("Bob Inc", BOB.public)
+        with pytest.raises(ValueError):
+            ALICE.sign_as(b"data", party)
+
+
+class TestCompositeKey:
+    def test_leaf_fulfilment(self):
+        leaf = ALICE.public.composite
+        assert leaf.is_fulfilled_by(ALICE.public)
+        assert not leaf.is_fulfilled_by(BOB.public)
+
+    def test_and_requirement(self):
+        both = CompositeKey.Builder().add_keys(ALICE.public, BOB.public).build()
+        assert both.threshold == 2
+        assert not both.is_fulfilled_by(ALICE.public)
+        assert both.is_fulfilled_by({ALICE.public, BOB.public})
+
+    def test_or_requirement(self):
+        either = CompositeKey.Builder().add_keys(ALICE.public, BOB.public).build(threshold=1)
+        assert either.is_fulfilled_by(ALICE.public)
+        assert either.is_fulfilled_by(BOB.public)
+        assert not either.is_fulfilled_by(CHARLIE.public)
+
+    def test_weighted_threshold(self):
+        # CEO weight 2, two assistants weight 1 each, threshold 2:
+        # CEO alone passes; one assistant fails; both assistants pass.
+        key = (
+            CompositeKey.Builder()
+            .add_key(ALICE.public, weight=2)
+            .add_key(BOB.public, weight=1)
+            .add_key(CHARLIE.public, weight=1)
+            .build(threshold=2)
+        )
+        assert key.is_fulfilled_by(ALICE.public)
+        assert not key.is_fulfilled_by(BOB.public)
+        assert key.is_fulfilled_by({BOB.public, CHARLIE.public})
+
+    def test_nested_tree(self):
+        inner = CompositeKey.Builder().add_keys(BOB.public, CHARLIE.public).build(threshold=1)
+        outer = CompositeKey.Builder().add_key(ALICE.public.composite).add_key(inner).build()
+        assert not outer.is_fulfilled_by(ALICE.public)
+        assert outer.is_fulfilled_by({ALICE.public, CHARLIE.public})
+        assert outer.keys == {ALICE.public, BOB.public, CHARLIE.public}
+
+    def test_contains_any_and_single(self):
+        leaf = ALICE.public.composite
+        assert leaf.single_key == ALICE.public
+        tree = CompositeKey.Builder().add_keys(ALICE.public, BOB.public).build()
+        assert tree.contains_any([BOB.public])
+        assert not tree.contains_any([CHARLIE.public])
+        with pytest.raises(ValueError):
+            _ = tree.single_key
+
+    def test_degenerate_nodes_rejected(self):
+        from corda_tpu.crypto import CompositeKeyNode
+
+        with pytest.raises(ValueError):
+            CompositeKey.Builder().build()  # no children
+        with pytest.raises(ValueError):
+            CompositeKeyNode(0, (ALICE.public.composite,), (1,))  # threshold 0
+        with pytest.raises(ValueError):
+            CompositeKeyNode(1, (ALICE.public.composite,), (0,))  # weight 0
+        with pytest.raises(ValueError):
+            CompositeKeyNode(1, (ALICE.public.composite,), (-1, 1))  # mismatch
+
+    def test_base58_roundtrip(self):
+        tree = CompositeKey.Builder().add_keys(ALICE.public, BOB.public).build(threshold=1)
+        assert CompositeKey.parse_from_base58(tree.to_base58_string()) == tree
+
+    def test_serialization_roundtrip(self):
+        tree = (
+            CompositeKey.Builder()
+            .add_key(ALICE.public, weight=3)
+            .add_key(BOB.public.composite)
+            .build(threshold=2)
+        )
+        assert deserialize(serialize(tree).bytes) == tree
+
+
+def leaves(n: int) -> list[SecureHash]:
+    return [SecureHash.sha256(bytes([i])) for i in range(n)]
+
+
+class TestMerkle:
+    def test_empty_rejected(self):
+        with pytest.raises(MerkleTreeException):
+            MerkleTree.build([])
+
+    def test_single_leaf_root(self):
+        (h,) = leaves(1)
+        assert MerkleTree.build([h]).hash == h
+
+    def test_two_leaves(self):
+        a, b = leaves(2)
+        assert MerkleTree.build([a, b]).hash == a.hash_concat(b)
+
+    def test_odd_level_duplicates_last(self):
+        a, b, c = leaves(3)
+        expect = a.hash_concat(b).hash_concat(c.hash_concat(c))
+        assert MerkleTree.build([a, b, c]).hash == expect
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 6, 7, 8, 9, 16, 31])
+    def test_partial_proofs_verify(self, n):
+        hs = leaves(n)
+        tree = MerkleTree.build(hs)
+        # Prove every single leaf and one multi-leaf subset.
+        for h in hs:
+            pmt = PartialMerkleTree.build(tree, [h])
+            assert pmt.verify(tree.hash, [h])
+        subset = hs[:: max(1, n // 3)]
+        pmt = PartialMerkleTree.build(tree, subset)
+        assert pmt.verify(tree.hash, subset)
+
+    def test_partial_proof_wrong_root_fails(self):
+        hs = leaves(5)
+        tree = MerkleTree.build(hs)
+        pmt = PartialMerkleTree.build(tree, [hs[2]])
+        assert not pmt.verify(SecureHash.zero(), [hs[2]])
+
+    def test_partial_proof_wrong_leaves_fails(self):
+        hs = leaves(5)
+        tree = MerkleTree.build(hs)
+        pmt = PartialMerkleTree.build(tree, [hs[2]])
+        assert not pmt.verify(tree.hash, [hs[3]])
+        assert not pmt.verify(tree.hash, [hs[2], hs[3]])
+
+    def test_unknown_hash_rejected_at_build(self):
+        hs = leaves(4)
+        tree = MerkleTree.build(hs)
+        with pytest.raises(MerkleTreeException):
+            PartialMerkleTree.build(tree, [SecureHash.sha256(b"not-in-tree")])
+
+    def test_duplicate_leaf_not_provable_as_real(self):
+        # With 3 leaves the 4th position is a duplicate of leaf 3; proving
+        # leaf 3 must still work and use the duplicate as a bare hash.
+        hs = leaves(3)
+        tree = MerkleTree.build(hs)
+        pmt = PartialMerkleTree.build(tree, [hs[2]])
+        assert pmt.verify(tree.hash, [hs[2]])
+        assert pmt.included_hashes() == [hs[2]]
+
+    def test_partial_tree_serialization_roundtrip(self):
+        hs = leaves(7)
+        tree = MerkleTree.build(hs)
+        pmt = PartialMerkleTree.build(tree, [hs[1], hs[4]])
+        restored = deserialize(serialize(pmt).bytes)
+        assert restored.verify(tree.hash, [hs[1], hs[4]])
+
+
+class TestSignedData:
+    def test_verified_returns_payload(self):
+        raw = serialize("the payload")
+        signed = SignedData(raw=raw, sig=ALICE.sign(raw.bytes))
+        assert signed.verified() == "the payload"
+
+    def test_tampered_payload_rejected(self):
+        raw = serialize("the payload")
+        sig = ALICE.sign(raw.bytes)
+        tampered = SignedData(raw=serialize("evil payload"), sig=sig)
+        with pytest.raises(SignatureError):
+            tampered.verified()

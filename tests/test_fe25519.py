@@ -115,3 +115,20 @@ def test_scalar_bits_msb():
         for i in range(256):
             got = (got << 1) | int(bits[i, j])
         assert got == n
+
+
+def test_reduce_midrange_limb_counts():
+    # Regression: the carry out of an n-limb input (20 < n < 39) has weight
+    # 2^(13n) and must fold at that position, not at 2^507.
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    for n in (21, 25, 30, 38, 39):
+        raw = rng.integers(0, 1 << 30, size=(n, 3), dtype=np.int64).astype(np.int32)
+        want = [
+            sum(int(raw[i, j]) << (fe.RADIX * i) for i in range(n)) % fe.P
+            for j in range(3)
+        ]
+        got = fe.reduce(jnp.asarray(raw))
+        for j in range(3):
+            assert fe.int_of_limbs(np.asarray(got)[:, j]) % fe.P == want[j], n

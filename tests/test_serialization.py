@@ -1,0 +1,107 @@
+"""Canonical codec: round-trips, determinism, whitelist enforcement.
+
+Mirrors the reference's KryoTests coverage (reference:
+core/src/test/kotlin/net/corda/core/serialization/KryoTests.kt) for the new
+canonical format.
+"""
+
+from dataclasses import dataclass, field
+
+import pytest
+
+from corda_tpu.crypto import KeyPair, Party, SecureHash
+from corda_tpu.serialization.codec import (
+    DeserializationError,
+    register,
+    serialize,
+    deserialize,
+    serialized_hash,
+)
+
+
+@register
+@dataclass(frozen=True)
+class _Sample:
+    name: str
+    values: tuple = ()
+    meta: dict = field(default_factory=dict)
+
+
+class TestPrimitives:
+    @pytest.mark.parametrize(
+        "value",
+        [
+            None,
+            True,
+            False,
+            0,
+            1,
+            -1,
+            2**70,
+            -(2**70),
+            b"",
+            b"\x00\xff" * 10,
+            "",
+            "unicode ✓ text",
+            (),
+            (1, "two", b"three", None),
+            {"a": 1, "b": (2, 3)},
+            frozenset({1, 2, 3}),
+        ],
+    )
+    def test_roundtrip(self, value):
+        assert deserialize(serialize(value).bytes) == value
+
+    def test_lists_become_tuples(self):
+        assert deserialize(serialize([1, 2, 3]).bytes) == (1, 2, 3)
+
+    def test_dict_encoding_is_insertion_order_independent(self):
+        assert serialize({"a": 1, "b": 2}).bytes == serialize({"b": 2, "a": 1}).bytes
+
+    def test_frozenset_encoding_is_order_independent(self):
+        a = frozenset({b"x", b"y", b"zzz"})
+        b = frozenset([b"zzz", b"y", b"x"])
+        assert serialize(a).bytes == serialize(b).bytes
+
+    def test_trailing_garbage_rejected(self):
+        blob = serialize(42).bytes + b"\x00"
+        with pytest.raises(DeserializationError):
+            deserialize(blob)
+
+    def test_truncation_rejected(self):
+        blob = serialize(b"payload-bytes").bytes
+        with pytest.raises(DeserializationError):
+            deserialize(blob[:-1])
+
+
+class TestObjects:
+    def test_dataclass_roundtrip(self):
+        obj = _Sample("x", (1, 2), {"k": b"v"})
+        assert deserialize(serialize(obj).bytes) == obj
+
+    def test_unregistered_type_rejected_on_write(self):
+        class Rogue:
+            pass
+
+        with pytest.raises(TypeError):
+            serialize(Rogue())
+
+    def test_unwhitelisted_name_rejected_on_read(self):
+        obj = _Sample("x")
+        blob = serialize(obj).bytes.replace(b"_Sample", b"_Evil00")
+        with pytest.raises(DeserializationError):
+            deserialize(blob)
+
+    def test_determinism(self):
+        kp = KeyPair.generate(b"\x07" * 32)
+        party = Party.of("MegaCorp", kp.public)
+        assert serialize(party).bytes == serialize(party).bytes
+        assert serialized_hash(party) == serialized_hash(party)
+        assert serialized_hash(party) != serialized_hash(Party.of("MiniCorp", kp.public))
+
+    def test_nested_core_types(self):
+        kp = KeyPair.generate(b"\x09" * 32)
+        party = Party.of("MegaCorp", kp.public)
+        sig = kp.sign(b"msg")
+        value = {"party": party, "sig": sig, "hash": SecureHash.sha256(b"x")}
+        assert deserialize(serialize(value).bytes) == value
