@@ -1,0 +1,37 @@
+"""L1 ledger data model: states, contracts, commands, transactions-for-contract."""
+
+from .structures import (  # noqa: F401
+    Attachment,
+    AuthenticatedObject,
+    Command,
+    CommandData,
+    ContractState,
+    Contract,
+    DealState,
+    FungibleAsset,
+    IssueCommand,
+    Issued,
+    LinearState,
+    MoveCommand,
+    OwnableState,
+    SchedulableState,
+    StateAndRef,
+    StateRef,
+    Timestamp,
+    TransactionState,
+    TypeOnlyCommandData,
+    UniqueIdentifier,
+)
+from .verification import (  # noqa: F401
+    InOutGroup,
+    TransactionForContract,
+    TransactionVerificationException,
+    ContractRejection,
+    MoreThanOneNotary,
+    NotaryChangeInWrongTransactionType,
+    SignersMissing,
+    InvalidNotaryChange,
+    TransactionMissingEncumbranceException,
+    TransactionResolutionException,
+)
+from .dsl import require_that, RequirementFailed  # noqa: F401

@@ -1,0 +1,119 @@
+"""Pluggable signature-verification provider — the batching seam.
+
+The reference hardwires per-signature verification into a sequential loop
+(reference: core/.../transactions/SignedTransaction.kt:83-87, engine built at
+core/.../crypto/CryptoUtilities.kt:63-96) and its whitepaper calls signature
+checking the embarrassingly-parallel hotspot (docs/source/whitepaper/
+corda-technical-whitepaper.tex:1597-1604). This module introduces the seam the
+reference lacks: everything that checks signatures goes through a
+BatchVerifier, so swapping the CPU oracle for the vmap'd JAX/TPU kernel
+(corda_tpu/ops/ed25519_jax.py) is a provider change, not a call-site change —
+the capability the reference gates behind CordaPluginRegistry-style plugins.
+
+Providers:
+  CpuVerifier  — per-signature pure-Python oracle; the conformance authority.
+  JaxVerifier  — batched JAX kernel (CPU backend in tests, TPU in prod), with
+                 optional shadow sampling: a fraction of batch results is
+                 re-checked against the oracle so TPU divergence is detected
+                 in production (SURVEY.md §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from . import ref_ed25519
+
+
+@dataclass(frozen=True)
+class VerifyJob:
+    """One signature check: does `sig` by `pubkey` cover `message`?"""
+
+    pubkey: bytes
+    message: bytes
+    sig: bytes
+
+
+class BatchVerifier:
+    """Interface: verify many independent Ed25519 signatures at once."""
+
+    name = "abstract"
+
+    def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        """Returns bool[N]; malformed input rejects (False), never raises."""
+        raise NotImplementedError
+
+
+class CpuVerifier(BatchVerifier):
+    """Sequential oracle loop — bit-identical accept/reject authority."""
+
+    name = "cpu-oracle"
+
+    def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        return np.array(
+            [ref_ed25519.verify(j.pubkey, j.message, j.sig) for j in jobs], bool
+        )
+
+
+class JaxVerifier(BatchVerifier):
+    """Batched JAX kernel with shadow-sampled oracle cross-checks.
+
+    shadow_rate: fraction of results re-verified on the CPU oracle; a mismatch
+    raises RuntimeError (divergence must never be silent).
+    """
+
+    name = "jax-batch"
+
+    def __init__(self, shadow_rate: float = 0.0, rng: random.Random | None = None):
+        self.shadow_rate = shadow_rate
+        self._rng = rng or random.Random(0)
+
+    def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        from ..ops import ed25519_jax
+
+        if not jobs:
+            return np.zeros(0, bool)
+        out = ed25519_jax.verify_batch(
+            [j.pubkey for j in jobs], [j.message for j in jobs], [j.sig for j in jobs]
+        )
+        if self.shadow_rate > 0.0:
+            for i in range(len(jobs)):
+                if self._rng.random() < self.shadow_rate:
+                    want = ref_ed25519.verify(
+                        jobs[i].pubkey, jobs[i].message, jobs[i].sig
+                    )
+                    if bool(out[i]) != want:
+                        raise RuntimeError(
+                            f"TPU/CPU verify divergence at index {i}: "
+                            f"kernel={bool(out[i])} oracle={want}"
+                        )
+        return out
+
+
+_default: BatchVerifier | None = None
+
+
+def get_verifier() -> BatchVerifier:
+    """The process-wide verifier. Defaults from CORDA_TPU_VERIFIER
+    (cpu | jax | jax-shadow); cpu if unset."""
+    global _default
+    if _default is None:
+        choice = os.environ.get("CORDA_TPU_VERIFIER", "cpu")
+        if choice == "jax":
+            _default = JaxVerifier()
+        elif choice == "jax-shadow":
+            _default = JaxVerifier(shadow_rate=0.05)
+        else:
+            _default = CpuVerifier()
+    return _default
+
+
+def set_verifier(verifier: BatchVerifier | None) -> None:
+    """Install a provider (None resets to environment default)."""
+    global _default
+    _default = verifier

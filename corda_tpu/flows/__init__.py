@@ -1,0 +1,29 @@
+"""L2/L3 flow framework: the programming model and the library flows."""
+
+from .api import (  # noqa: F401
+    FlowException,
+    FlowLogic,
+    FlowSessionException,
+    ReceiveRequest,
+    SendAndReceiveRequest,
+    SendRequest,
+    UntrustworthyData,
+    VerifyTxRequest,
+    flow_registry,
+    register_flow,
+)
+from .notary import (  # noqa: F401
+    NotaryClientFlow,
+    NotaryConflict,
+    NotaryError,
+    NotaryException,
+    NotaryServiceFlow,
+    NotarySignaturesMissing,
+    NotaryTimestampInvalid,
+    NotaryTransactionInvalid,
+    ValidatingNotaryFlow,
+)
+from .fetch import FetchAttachmentsFlow, FetchTransactionsFlow  # noqa: F401
+from .resolve import ResolveTransactionsFlow  # noqa: F401
+from .finality import BroadcastTransactionFlow, FinalityFlow  # noqa: F401
+from .data_vending import install_data_vending  # noqa: F401

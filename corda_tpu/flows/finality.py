@@ -1,0 +1,70 @@
+"""Finalising and broadcasting transactions.
+
+Capability match for the reference's FinalityFlow (reference:
+core/src/main/kotlin/net/corda/flows/FinalityFlow.kt:17-51) and
+BroadcastTransactionFlow (core/.../flows/BroadcastTransactionFlow.kt):
+notarise if needed, record locally, then notify every participant, whose
+data-vending NotifyTransactionHandler resolves and records it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto.party import Party
+from ..serialization.codec import register
+from ..transactions.signed import SignedTransaction
+from .api import FlowLogic, register_flow
+from .notary import NotaryClientFlow
+
+
+@register
+@dataclass(frozen=True)
+class NotifyTxRequest:
+    tx: SignedTransaction
+
+
+@register_flow
+class BroadcastTransactionFlow(FlowLogic):
+    """Record locally and notify participants (BroadcastTransactionFlow.kt)."""
+
+    def __init__(self, notarised_transaction: SignedTransaction, participants: tuple):
+        self.notarised_transaction = notarised_transaction
+        self.participants = tuple(participants)
+
+    def call(self):
+        self.service_hub.record_transactions([self.notarised_transaction])
+        msg = NotifyTxRequest(self.notarised_transaction)
+        me = self.service_hub.my_identity
+        for participant in self.participants:
+            if participant != me:
+                yield self.send(participant, msg)
+        return None
+
+
+@register_flow
+class FinalityFlow(FlowLogic):
+    """Notarise (if needed) then broadcast (FinalityFlow.kt:27-51)."""
+
+    def __init__(self, transaction: SignedTransaction, participants: tuple):
+        self.transaction = transaction
+        self.participants = tuple(participants)
+
+    def call(self):
+        stx = self.transaction
+        if self._needs_notary_signature(stx):
+            notary_sig = yield from self.sub_flow(NotaryClientFlow(stx))
+            stx = stx.with_additional_signature(notary_sig)
+        yield from self.sub_flow(
+            BroadcastTransactionFlow(stx, self.participants),
+            share_parent_sessions=True,
+        )
+        return stx
+
+    @staticmethod
+    def _needs_notary_signature(stx: SignedTransaction) -> bool:
+        notary = stx.tx.notary
+        if notary is None:
+            return False
+        signers = {sig.by for sig in stx.sigs}
+        return not notary.owning_key.is_fulfilled_by(signers)

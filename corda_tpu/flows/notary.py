@@ -1,0 +1,239 @@
+"""Notary flows: the uniqueness-consensus round trip — the north-star path.
+
+Capability match for the reference's NotaryFlow (reference:
+core/src/main/kotlin/net/corda/flows/NotaryFlow.kt) and ValidatingNotaryFlow
+(core/.../flows/ValidatingNotaryFlow.kt). Protocol (NotaryFlow.kt:96-147):
+
+  client: verify own signatures → sendAndReceive(SignRequest) → validate reply
+  notary: receive → validate timestamp → beforeCommit (validating variant:
+          check signatures + resolve dependencies + run contracts) → commit
+          inputs to the uniqueness provider → sign tx id → reply
+
+TPU-first difference: every signature check suspends into the node's
+micro-batched verifier (VerifyTxRequest) so concurrent notarisation requests
+verify as ONE kernel batch — the reference's sequential hot loop
+(SignedTransaction.kt:83-87) becomes the batch axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto.composite import CompositeKey
+from ..crypto.hashes import SecureHash
+from ..crypto.keys import DigitalSignature, SignatureError
+from ..crypto.party import Party
+from ..crypto.signed_data import SignedData
+from ..serialization.codec import register
+from ..transactions.signed import SignedTransaction
+from .api import FlowException, FlowLogic, FlowSessionException, register_flow
+
+
+# ---------------------------------------------------------------------------
+# Wire types (reference: NotaryFlow.kt:150-158) and errors (:163-183)
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class SignRequest:
+    tx: SignedTransaction
+    caller_identity: Party
+
+
+@register
+@dataclass(frozen=True)
+class NotarySuccess:
+    sig: DigitalSignature.WithKey
+
+
+@register
+@dataclass(frozen=True)
+class NotaryFailure:
+    error: "NotaryError"
+
+
+class NotaryError:
+    """Marker base (reference: NotaryError sealed class)."""
+
+
+@register
+@dataclass(frozen=True)
+class NotaryConflict(NotaryError):
+    """Input(s) already consumed; conflict evidence signed by the notary."""
+
+    tx_id: SecureHash
+    signed_conflict: SignedData
+
+    def __str__(self):
+        return (
+            f"One or more input states for transaction {self.tx_id} have been "
+            "used in another transaction"
+        )
+
+
+@register
+@dataclass(frozen=True)
+class NotaryTimestampInvalid(NotaryError):
+    pass
+
+
+@register
+@dataclass(frozen=True)
+class NotaryTransactionInvalid(NotaryError):
+    pass
+
+
+@register
+@dataclass(frozen=True)
+class NotarySignaturesMissing(NotaryError):
+    missing: frozenset
+
+    def __str__(self):
+        return f"Missing signatures from: {sorted(self.missing, key=repr)}"
+
+
+class NotaryException(FlowException):
+    def __init__(self, error: NotaryError):
+        super().__init__(f"Error response from Notary - {error}")
+        self.error = error
+
+
+# ---------------------------------------------------------------------------
+# Client (reference: NotaryFlow.kt:24-81)
+# ---------------------------------------------------------------------------
+
+
+@register_flow
+class NotaryClientFlow(FlowLogic):
+    """Obtain the notary's uniqueness signature over a transaction."""
+
+    def __init__(self, stx: SignedTransaction):
+        self.stx = stx
+
+    def call(self):
+        wtx = self.stx.tx
+        notary_party = wtx.notary
+        if notary_party is None:
+            raise FlowException("Transaction does not specify a Notary")
+        for ref in wtx.inputs:
+            state = self.service_hub.load_state(ref)
+            if state is not None and state.notary != notary_party:
+                raise FlowException("Input states must have the same Notary")
+        # Check our own signature set (batched with everything else pending
+        # on this node); the notary's signature is the one allowed missing.
+        try:
+            yield self.verify_signatures_batched(self.stx, notary_party.owning_key)
+        except SignatureError as e:
+            raise NotaryException(
+                NotarySignaturesMissing(frozenset(self.stx.get_missing_signatures()))
+            ) from e
+
+        request = SignRequest(self.stx, self.service_hub.my_identity)
+        response = yield self.send_and_receive(notary_party, request)
+        result = response.unwrap()
+
+        if isinstance(result, NotarySuccess):
+            sig = result.sig
+            if sig.by not in notary_party.owning_key.keys:
+                raise FlowException("Invalid signer for the notary result")
+            sig.verify(self.stx.id.bytes)
+            return sig
+        if isinstance(result, NotaryFailure):
+            if isinstance(result.error, NotaryConflict):
+                result.error.signed_conflict.verified()  # authenticates evidence
+            raise NotaryException(result.error)
+        raise FlowSessionException(
+            f"Received invalid result from Notary service {notary_party}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Service (reference: NotaryFlow.kt:96-147)
+# ---------------------------------------------------------------------------
+
+
+@register_flow
+class NotaryServiceFlow(FlowLogic):
+    """The non-validating notary: commits inputs without seeing history.
+
+    `service` is the node's NotaryServiceBase (a checkpoint token) exposing
+    timestamp_checker, uniqueness_provider and signing.
+    """
+
+    def __init__(self, other_side: Party, service):
+        self.other_side = other_side
+        self.service = service
+
+    def call(self):
+        req = yield self.receive(self.other_side, SignRequest)
+        request = req.unwrap()
+        stx = request.tx
+        req_identity = request.caller_identity
+        try:
+            wtx = stx.tx
+            self._validate_timestamp(wtx)
+            yield from self.before_commit(stx, req_identity)
+            self._commit_input_states(wtx, req_identity)
+            sig = self.service.sign(stx.id.bytes)
+            result = NotarySuccess(sig)
+        except NotaryException as e:
+            result = NotaryFailure(e.error)
+        yield self.send(self.other_side, result)
+        return None
+
+    def _validate_timestamp(self, wtx) -> None:
+        if wtx.timestamp is not None and not self.service.timestamp_checker.is_valid(
+            wtx.timestamp
+        ):
+            raise NotaryException(NotaryTimestampInvalid())
+
+    def before_commit(self, stx: SignedTransaction, req_identity: Party):
+        """Non-validating: no history check (NotaryFlow.kt:121-130)."""
+        return
+        yield  # pragma: no cover — makes this a generator for yield-from
+
+    def _commit_input_states(self, wtx, req_identity: Party) -> None:
+        from ..node.services.api import UniquenessException
+        from ..serialization.codec import serialize
+
+        try:
+            self.service.uniqueness_provider.commit(wtx.inputs, wtx.id, req_identity)
+        except UniquenessException as e:
+            conflict_data = serialize(e.error)
+            signed = SignedData(conflict_data, self.service.sign(conflict_data.bytes))
+            raise NotaryException(NotaryConflict(wtx.id, signed)) from e
+
+
+@register_flow
+class ValidatingNotaryFlow(NotaryServiceFlow):
+    """Fully validates the transaction (signatures, dependency resolution,
+    contract code) before committing (reference: ValidatingNotaryFlow.kt:23-50).
+    The caller reveals its transaction history in exchange for stronger
+    notarisation guarantees."""
+
+    def before_commit(self, stx: SignedTransaction, req_identity: Party):
+        from ..contracts.verification import TransactionVerificationException
+        from .resolve import ResolveTransactionsFlow
+
+        try:
+            # THE hot spot: micro-batched across all concurrent requests.
+            try:
+                yield self.verify_signatures_batched(
+                    stx, self.service.notary_identity.owning_key
+                )
+            except SignatureError as e:
+                # Distinguish missing vs invalid as the reference does.
+                missing = stx.get_missing_signatures()
+                if missing and "did not match" not in str(e):
+                    raise NotaryException(
+                        NotarySignaturesMissing(frozenset(missing))
+                    ) from e
+                raise
+            wtx = stx.tx
+            yield from self.sub_flow(ResolveTransactionsFlow(wtx, self.other_side))
+            wtx.to_ledger_transaction(self.service_hub).verify()
+        except NotaryException:
+            raise
+        except (TransactionVerificationException, SignatureError) as e:
+            raise NotaryException(NotaryTransactionInvalid()) from e

@@ -1,0 +1,2 @@
+"""L4/L5 node runtime: services, messaging, state machine manager, notaries,
+node assembly."""

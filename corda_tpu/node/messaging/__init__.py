@@ -1,0 +1,4 @@
+"""L5a messaging: transport-neutral API, deterministic in-memory fake, TCP."""
+
+from .api import Message, MessagingService, TopicSession, DEFAULT_SESSION_ID  # noqa: F401
+from .inmem import InMemoryMessagingNetwork  # noqa: F401

@@ -1,0 +1,16 @@
+"""Service interfaces (L4) and in-memory/persistent implementations (L5)."""
+
+from .api import (  # noqa: F401
+    IdentityService,
+    KeyManagementService,
+    NetworkMapCache,
+    NodeInfo,
+    ServiceHub,
+    ServiceInfo,
+    ServiceType,
+    StorageService,
+    UniquenessConflict,
+    UniquenessException,
+    UniquenessProvider,
+    VaultService,
+)

@@ -1,0 +1,342 @@
+"""Service interfaces — the ServiceHub surface.
+
+Capability match for the reference's core node-services API (reference:
+core/src/main/kotlin/net/corda/core/node/ServiceHub.kt:22-77 and
+core/src/main/kotlin/net/corda/core/node/services/Services.kt,
+UniquenessProvider.kt, NetworkMapCache.kt, IdentityService.kt,
+ServiceType.kt, NodeInfo.kt): every flow and service reaches the node's
+capabilities through this registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Sequence
+
+from ...contracts.structures import StateAndRef, StateRef, Timestamp, TransactionState
+from ...crypto.composite import CompositeKey
+from ...crypto.hashes import SecureHash
+from ...crypto.keys import DigitalSignature, KeyPair, PublicKey
+from ...crypto.party import Party
+from ...serialization.codec import register
+
+
+# ---------------------------------------------------------------------------
+# Service descriptors (reference: ServiceType.kt:35-60, NodeInfo.kt)
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class ServiceType:
+    """Hierarchical dotted service identifier (reference: ServiceType.kt)."""
+
+    id: str
+
+    def is_sub_type_of(self, parent: "ServiceType") -> bool:
+        return self.id == parent.id or self.id.startswith(parent.id + ".")
+
+    def get_sub_type(self, sub: str) -> "ServiceType":
+        return ServiceType(f"{self.id}.{sub}")
+
+    def __str__(self) -> str:
+        return self.id
+
+
+CORDA_SERVICE = ServiceType("corda")
+NOTARY_TYPE = CORDA_SERVICE.get_sub_type("notary")
+SIMPLE_NOTARY = NOTARY_TYPE.get_sub_type("simple")
+VALIDATING_NOTARY = NOTARY_TYPE.get_sub_type("validating")
+RAFT_VALIDATING_NOTARY = VALIDATING_NOTARY.get_sub_type("raft")
+NETWORK_MAP_TYPE = CORDA_SERVICE.get_sub_type("network_map")
+
+
+@register
+@dataclass(frozen=True)
+class ServiceInfo:
+    """An advertised service: type plus optional cluster identity name
+    (reference: ServiceInfo in ServiceType.kt)."""
+
+    type: ServiceType
+    name: str | None = None
+
+
+@register
+@dataclass(frozen=True)
+class PhysicalLocation:
+    """Approximate geography for visualisation (reference:
+    core/.../node/PhysicalLocationStructures.kt)."""
+
+    latitude: float | None = None
+    longitude: float | None = None
+    description: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class NodeInfo:
+    """Everything the network map knows about a node (reference: NodeInfo.kt):
+    its transport address, legal identity, advertised services."""
+
+    address: Any  # a MessageRecipient understood by the messaging layer
+    legal_identity: Party
+    advertised_services: tuple[ServiceInfo, ...] = ()
+    physical_location: PhysicalLocation | None = None
+
+    @property
+    def notary_identity(self) -> Party:
+        return self.legal_identity
+
+
+# ---------------------------------------------------------------------------
+# Vault (reference: Services.kt:41-200)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Vault:
+    """An immutable snapshot of unconsumed states (reference: Services.kt:41)."""
+
+    states: tuple[StateAndRef, ...]
+
+    @dataclass(frozen=True)
+    class Update:
+        """Delta produced by a transaction hitting the vault
+        (reference: Services.kt:58-78)."""
+
+        consumed: frozenset
+        produced: frozenset
+
+        @property
+        def is_empty(self) -> bool:
+            return not self.consumed and not self.produced
+
+        def __add__(self, rhs: "Vault.Update") -> "Vault.Update":
+            combined_produced = (self.produced - rhs.consumed) | rhs.produced
+            return Vault.Update(
+                consumed=self.consumed | (rhs.consumed - self.produced),
+                produced=combined_produced,
+            )
+
+
+NO_UPDATE = Vault.Update(frozenset(), frozenset())
+
+
+class VaultService:
+    """Tracks unconsumed states relevant to the node (reference:
+    Services.kt:95-200)."""
+
+    @property
+    def current_vault(self) -> Vault:
+        raise NotImplementedError
+
+    def notify_all(self, txns: Iterable) -> Vault:
+        """Feed observed (verified) transactions into the vault."""
+        raise NotImplementedError
+
+    def notify(self, tx) -> Vault:
+        return self.notify_all([tx])
+
+    def subscribe(self, observer: Callable[[Vault.Update], None]) -> None:
+        raise NotImplementedError
+
+    def states_of_type(self, cls: type) -> list[StateAndRef]:
+        return [s for s in self.current_vault.states if isinstance(s.state.data, cls)]
+
+
+# ---------------------------------------------------------------------------
+# Identity, keys, storage (reference: Services.kt:206-260, IdentityService.kt)
+# ---------------------------------------------------------------------------
+
+
+class IdentityService:
+    """Key → Party lookups (reference: core/.../services/IdentityService.kt)."""
+
+    def register_identity(self, party: Party) -> None:
+        raise NotImplementedError
+
+    def party_from_key(self, key: CompositeKey) -> Party | None:
+        raise NotImplementedError
+
+    def party_from_name(self, name: str) -> Party | None:
+        raise NotImplementedError
+
+
+class KeyManagementService:
+    """The node's signing keys (reference: Services.kt:206-224)."""
+
+    @property
+    def keys(self) -> dict[PublicKey, KeyPair]:
+        raise NotImplementedError
+
+    def fresh_key(self) -> KeyPair:
+        raise NotImplementedError
+
+    def sign(self, content: bytes, with_key: PublicKey) -> DigitalSignature.WithKey:
+        raise NotImplementedError
+
+
+class AttachmentStorage:
+    """Content-addressed attachment blobs (reference:
+    core/.../services/AttachmentStorage in Services.kt:226+)."""
+
+    def open_attachment(self, id: SecureHash):
+        raise NotImplementedError
+
+    def import_attachment(self, data: bytes) -> SecureHash:
+        raise NotImplementedError
+
+
+class TransactionStorage:
+    """Validated-transaction map (reference: core/.../services/
+    TransactionStorage in Services.kt)."""
+
+    def add_transaction(self, stx) -> None:
+        raise NotImplementedError
+
+    def get_transaction(self, id: SecureHash):
+        raise NotImplementedError
+
+    def subscribe(self, observer: Callable) -> None:
+        raise NotImplementedError
+
+
+@dataclass
+class StorageService:
+    """Bundle of storage sub-services (reference: Services.kt:226-259)."""
+
+    validated_transactions: TransactionStorage
+    attachments: AttachmentStorage
+    state_machine_recorded_transaction_mapping: Any = None
+
+
+# ---------------------------------------------------------------------------
+# Uniqueness (reference: UniquenessProvider.kt:13-32)
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class ConsumingTx:
+    """Who consumed an input and where (reference: UniquenessProvider.kt:24-30)."""
+
+    id: SecureHash
+    input_index: int
+    requesting_party: Party
+
+
+@register
+@dataclass(frozen=True)
+class UniquenessConflict:
+    """The double-spend evidence returned on conflict
+    (reference: UniquenessProvider.kt:22)."""
+
+    state_history: dict  # StateRef -> ConsumingTx
+
+
+class UniquenessException(Exception):
+    def __init__(self, error: UniquenessConflict):
+        super().__init__(f"Uniqueness conflict: {error}")
+        self.error = error
+
+
+class UniquenessProvider:
+    """First-committer-wins input commit log (reference:
+    UniquenessProvider.kt:13-20)."""
+
+    def commit(
+        self,
+        states: Sequence[StateRef],
+        tx_id: SecureHash,
+        caller_identity: Party,
+    ) -> None:
+        """Atomically claim all states for tx_id or raise UniquenessException."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Network map cache (reference: NetworkMapCache.kt)
+# ---------------------------------------------------------------------------
+
+
+class NetworkMapCache:
+    """Local directory of known nodes."""
+
+    @property
+    def party_nodes(self) -> list[NodeInfo]:
+        raise NotImplementedError
+
+    @property
+    def notary_nodes(self) -> list[NodeInfo]:
+        return [
+            n
+            for n in self.party_nodes
+            if any(s.type.is_sub_type_of(NOTARY_TYPE) for s in n.advertised_services)
+        ]
+
+    def get_node_by_legal_identity(self, party: Party) -> NodeInfo | None:
+        for n in self.party_nodes:
+            if n.legal_identity == party:
+                return n
+        return None
+
+    def get_nodes_with_service(self, service_type: ServiceType) -> list[NodeInfo]:
+        return [
+            n
+            for n in self.party_nodes
+            if any(s.type.is_sub_type_of(service_type) for s in n.advertised_services)
+        ]
+
+    def get_any_notary(self) -> Party | None:
+        nodes = self.notary_nodes
+        return nodes[0].notary_identity if nodes else None
+
+    def add_node(self, node: NodeInfo) -> None:
+        raise NotImplementedError
+
+    def remove_node(self, node: NodeInfo) -> None:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# The hub (reference: ServiceHub.kt:22-77)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServiceHub:
+    """The service registry handed to flows and services."""
+
+    identity_service: IdentityService
+    key_management_service: KeyManagementService
+    storage_service: StorageService
+    vault_service: VaultService
+    network_map_cache: NetworkMapCache
+    clock: Any = None
+    my_info: NodeInfo | None = None
+
+    def load_state(self, ref: StateRef) -> TransactionState | None:
+        """Resolve a StateRef via validated-transaction storage
+        (ServiceHub.kt:59-67)."""
+        stx = self.storage_service.validated_transactions.get_transaction(ref.txhash)
+        if stx is None:
+            return None
+        return stx.tx.outputs[ref.index]
+
+    def record_transactions(self, txs) -> None:
+        """Store + vault-notify observed transactions (ServiceHub.kt:38-46)."""
+        txs = list(txs)
+        for stx in txs:
+            self.storage_service.validated_transactions.add_transaction(stx)
+        self.vault_service.notify_all(txs)
+
+    @property
+    def legal_identity_key(self) -> KeyPair:
+        assert self.my_info is not None
+        key = self.my_info.legal_identity.owning_key.single_key
+        return self.key_management_service.keys[key]
+
+    @property
+    def my_identity(self) -> Party:
+        assert self.my_info is not None
+        return self.my_info.legal_identity

@@ -1,0 +1,935 @@
+"""The state machine manager: flow scheduling, sessions, checkpoints, and the
+micro-batched verification seam.
+
+Capability match for the reference's StateMachineManager +
+FlowStateMachineImpl (reference: node/src/main/kotlin/net/corda/node/services/
+statemachine/StateMachineManager.kt, FlowStateMachineImpl.kt):
+
+  * session wire protocol SessionInit/Confirm/Reject/Data/End exactly as the
+    reference defines it (StateMachineManager.kt:443-482), carried on topic
+    "platform.session" with the recipient's session id as the message session
+    (StateMachineManager.kt:209-217);
+  * flow-factory registration for service-initiated flows
+    (onSessionInit, StateMachineManager.kt:257-286);
+  * checkpoint on every suspension (updateCheckpoint,
+    StateMachineManager.kt:399-408) — but instead of Kryo-serializing a fiber
+    stack the checkpoint records (flow name, constructor args, ordered results
+    of completed suspensions, session states); restore re-runs the flow
+    generator and replays the recorded results (deterministic replay — the
+    explicit-state-machine design SURVEY.md §7 stage 3 calls for);
+  * restore-on-start (restoreFibersFromCheckpoints,
+    StateMachineManager.kt:190-226).
+
+TPU-first addition — the *verification pump*: flows suspend on VerifyTxRequest
+and the manager aggregates every pending request across all concurrent flows
+into ONE batched signature-verification call (the seam the reference lacks:
+its per-tx loop at SignedTransaction.kt:83-87 becomes a cross-transaction
+batch sized by concurrency). Single-threaded cooperative scheduling makes
+this deterministic: flows run until all are parked, then the batch flushes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable
+
+from ..crypto.hashes import SecureHash
+from ..crypto.keys import SignatureError
+from ..crypto.party import Party
+from ..crypto.provider import BatchVerifier, VerifyJob, get_verifier
+from ..flows.api import (
+    FlowException,
+    FlowLogic,
+    FlowSessionException,
+    ReceiveRequest,
+    SendAndReceiveRequest,
+    SendRequest,
+    UntrustworthyData,
+    VerifyTxRequest,
+    flow_registry,
+)
+from ..serialization.codec import deserialize, register, serialize
+from ..serialization.tokens import TokenContext
+from .messaging.api import DEFAULT_SESSION_ID, Message, MessagingService, TopicSession
+
+logger = logging.getLogger(__name__)
+
+SESSION_TOPIC = "platform.session"
+
+
+# ---------------------------------------------------------------------------
+# Session wire messages (reference: StateMachineManager.kt:443-482)
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class SessionInit:
+    initiator_session_id: int
+    flow_name: str
+    initiator_party: Party
+    first_payload: Any = None
+
+
+@register
+@dataclass(frozen=True)
+class SessionConfirm:
+    initiator_session_id: int
+    initiated_session_id: int
+
+
+@register
+@dataclass(frozen=True)
+class SessionReject:
+    initiator_session_id: int
+    error_message: str
+
+
+@register
+@dataclass(frozen=True)
+class SessionData:
+    recipient_session_id: int
+    payload: Any
+
+
+@register
+@dataclass(frozen=True)
+class SessionEnd:
+    recipient_session_id: int
+
+
+# ---------------------------------------------------------------------------
+# Checkpoints
+# ---------------------------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class SessionCheckpoint:
+    """Serializable session state."""
+
+    party: Party
+    local_id: int
+    peer_id: int | None
+    state: str  # initiating | open | ended
+    receive_buffer: tuple = ()
+    outgoing_buffer: tuple = ()
+    send_count: int = 0
+    scope: str = ""
+
+
+@register
+@dataclass(frozen=True)
+class Checkpoint:
+    """One flow's durable state (reference: node/.../services/api/
+    CheckpointStorage.kt:33 — here replay state instead of a fiber blob)."""
+
+    run_id: bytes
+    flow_name: str
+    flow_args: tuple
+    resolved: tuple = ()  # ('v', value) | ('e', exc_type_name, message)
+    sessions: tuple = ()  # SessionCheckpoint...
+    next_session_seq: int = 0
+
+    @property
+    def id(self) -> SecureHash:
+        return SecureHash.sha256(serialize(self).bytes)
+
+
+class CheckpointStorage:
+    """Interface over serialized checkpoint blobs (reference:
+    CheckpointStorage.kt:10-30). Blobs, not objects: serialization happens on
+    every suspend (as in the reference), so unserializable flow state fails
+    fast, and service references pass through the token context."""
+
+    def update_checkpoint(self, run_id: bytes, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def remove_checkpoint(self, run_id: bytes) -> None:
+        raise NotImplementedError
+
+    def checkpoints(self) -> list[bytes]:
+        raise NotImplementedError
+
+
+class InMemoryCheckpointStorage(CheckpointStorage):
+    def __init__(self):
+        self._by_run: dict[bytes, bytes] = {}
+
+    def update_checkpoint(self, run_id: bytes, blob: bytes) -> None:
+        self._by_run[run_id] = blob
+
+    def remove_checkpoint(self, run_id: bytes) -> None:
+        self._by_run.pop(run_id, None)
+
+    def checkpoints(self) -> list[bytes]:
+        return list(self._by_run.values())
+
+    def __len__(self):
+        return len(self._by_run)
+
+
+# ---------------------------------------------------------------------------
+# Futures
+# ---------------------------------------------------------------------------
+
+
+class FlowFuture:
+    """Synchronous future resolved by the manager's pump."""
+
+    def __init__(self):
+        self._done = False
+        self._result = None
+        self._exception: BaseException | None = None
+        self._callbacks: list[Callable] = []
+
+    def set_result(self, value) -> None:
+        self._done, self._result = True, value
+        for cb in self._callbacks:
+            cb(self)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._done, self._exception = True, exc
+        for cb in self._callbacks:
+            cb(self)
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def result(self):
+        if not self._done:
+            raise RuntimeError("flow not finished — pump the network first")
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+    def exception(self) -> BaseException | None:
+        return self._exception
+
+    def add_done_callback(self, cb: Callable) -> None:
+        if self._done:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+
+@dataclass
+class FlowHandle:
+    run_id: bytes
+    result: FlowFuture
+    logic: FlowLogic
+
+
+# ---------------------------------------------------------------------------
+# Sessions (runtime form)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FlowSession:
+    party: Party
+    local_id: int
+    peer_id: int | None = None
+    state: str = "initiating"
+    receive_buffer: list = field(default_factory=list)
+    outgoing_buffer: list = field(default_factory=list)  # payloads pre-confirm
+    send_count: int = 0
+    scope: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.scope}|{self.party.name}"
+
+    def to_checkpoint(self) -> SessionCheckpoint:
+        return SessionCheckpoint(
+            party=self.party,
+            local_id=self.local_id,
+            peer_id=self.peer_id,
+            state=self.state,
+            receive_buffer=tuple(self.receive_buffer),
+            outgoing_buffer=tuple(self.outgoing_buffer),
+            send_count=self.send_count,
+            scope=self.scope,
+        )
+
+    @staticmethod
+    def from_checkpoint(sc: SessionCheckpoint) -> "FlowSession":
+        return FlowSession(
+            party=sc.party,
+            local_id=sc.local_id,
+            peer_id=sc.peer_id,
+            state=sc.state,
+            receive_buffer=list(sc.receive_buffer),
+            outgoing_buffer=list(sc.outgoing_buffer),
+            send_count=sc.send_count,
+            scope=sc.scope,
+        )
+
+
+# ---------------------------------------------------------------------------
+# The per-flow state machine
+# ---------------------------------------------------------------------------
+
+_RUNNABLE = "runnable"
+_WAIT_RECEIVE = "wait_receive"
+_WAIT_VERIFY = "wait_verify"
+_DONE = "done"
+
+
+class FlowStateMachine:
+    """Drives one FlowLogic generator; owns its sessions and replay log."""
+
+    def __init__(
+        self,
+        manager: "StateMachineManager",
+        logic: FlowLogic,
+        run_id: bytes,
+        resolved: list | None = None,
+        sessions: dict[str, FlowSession] | None = None,
+        next_session_seq: int = 0,
+    ):
+        self.manager = manager
+        self.logic = logic
+        self.run_id = run_id
+        self.resolved: list = resolved or []  # completed suspension results
+        self.sessions: dict[str, FlowSession] = sessions or {}  # by scope|party
+        self.next_session_seq = next_session_seq
+        self._subflow_counter = 0
+        self.future = FlowFuture()
+        self.state = _RUNNABLE
+        self.waiting_on: ReceiveRequest | None = None
+        self.pending_value = None  # (kind, value) to feed into generator
+        self._gen = None
+        self._replay_cursor = 0
+        logic.state_machine = self
+        logic.service_hub = manager.service_hub
+
+    # -- session helpers ---------------------------------------------------
+
+    def _session_id(self, seq: int) -> int:
+        digest = hashlib.sha256(self.run_id + seq.to_bytes(4, "big")).digest()
+        return int.from_bytes(digest[:8], "big") >> 1  # positive int64
+
+    def allocate_subflow_scope(self) -> str:
+        """Deterministic scope names for sub-flow sessions; replay re-derives
+        the same values because sub_flow calls re-execute in order."""
+        self._subflow_counter += 1
+        return str(self._subflow_counter)
+
+    def get_or_open_session(
+        self, party: Party, scope: str = "", flow_name: str = "", first_payload=None
+    ) -> FlowSession:
+        key = f"{scope}|{party.name}"
+        session = self.sessions.get(key)
+        if session is not None:
+            return session
+        local_id = self._session_id(self.next_session_seq)
+        self.next_session_seq += 1
+        session = FlowSession(party=party, local_id=local_id, scope=scope)
+        self.sessions[key] = session
+        self.manager._register_session(self, session)
+        if not self.replaying:
+            self.manager._send_session_message(
+                party,
+                DEFAULT_SESSION_ID,
+                SessionInit(
+                    initiator_session_id=local_id,
+                    flow_name=flow_name
+                    or type(self.logic).flow_name
+                    or type(self.logic).__qualname__,
+                    initiator_party=self.manager.our_identity,
+                    first_payload=first_payload,
+                ),
+            )
+            if first_payload is not None:
+                session.send_count += 1
+        return session
+
+    def open_initiated_session(self, party: Party, local_id: int, peer_id: int) -> FlowSession:
+        session = FlowSession(party=party, local_id=local_id, peer_id=peer_id, state="open")
+        self.sessions[session.key] = session
+        self.manager._register_session(self, session)
+        return session
+
+    def _send_on_session(self, request) -> None:
+        key = f"{request.scope}|{request.party.name}"
+        session = self.sessions.get(key)
+        if session is None:
+            self.get_or_open_session(
+                request.party, request.scope, request.flow_name,
+                first_payload=request.payload,
+            )
+            return
+        if self.replaying:
+            return  # effect already happened before the checkpoint
+        if session.state == "initiating":
+            session.outgoing_buffer.append(request.payload)
+        elif session.state == "open":
+            self.manager._send_session_message(
+                request.party,
+                session.peer_id,
+                SessionData(session.peer_id, request.payload),
+            )
+            session.send_count += 1
+        else:
+            raise FlowSessionException(f"session with {request.party} has ended")
+
+    # -- replay ------------------------------------------------------------
+
+    @property
+    def replaying(self) -> bool:
+        return self._replay_cursor < len(self.resolved)
+
+    def _record(self, kind: str, value=None, err: BaseException | None = None):
+        if kind == "v":
+            self.resolved.append(("v", value))
+        else:
+            self.resolved.append(("e", type(err).__name__, str(err)))
+        self._replay_cursor = len(self.resolved)
+
+    def _next_feed(self):
+        """What to send into the generator for the current step."""
+        if self._replay_cursor < len(self.resolved):
+            entry = self.resolved[self._replay_cursor]
+            self._replay_cursor += 1
+            return entry
+        pv, self.pending_value = self.pending_value, None
+        return pv
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> None:
+        """Advance the generator until it parks or finishes. Called only by
+        the manager's pump (single-threaded)."""
+        import inspect as _inspect
+
+        if self.state == _DONE:
+            return
+        try:
+            if self._gen is None:
+                out = self.logic.call()
+                if not _inspect.isgenerator(out):
+                    self._finish(out)
+                    return
+                self._gen = out
+                feed = None
+            else:
+                feed = self._next_feed()
+
+            while True:
+                if feed is None:
+                    request = next(self._gen)
+                elif feed[0] == "v":
+                    request = self._gen.send(feed[1])
+                else:
+                    request = self._gen.throw(_rebuild_exception(feed))
+
+                feed = self._handle_request(request)
+                if feed is _PARKED:
+                    return
+        except StopIteration as stop:
+            self._finish(stop.value)
+        except BaseException as e:  # flow failed
+            self._fail(e)
+
+    def _handle_request(self, request):
+        """Execute or park on a yielded request. Returns the next feed tuple,
+        or _PARKED if the flow must suspend."""
+        if isinstance(request, SendRequest):
+            if self.replaying:
+                self._send_on_session(request)  # suppressed
+                return self._consume_replay_entry()
+            self._send_on_session(request)
+            self._record("v", None)
+            self.manager._checkpoint(self)
+            return ("v", None)
+        if isinstance(request, SendAndReceiveRequest):
+            self._send_on_session(request)
+            return self._park_receive(
+                ReceiveRequest(
+                    request.party, request.expected_type, request.scope, request.flow_name
+                )
+            )
+        if isinstance(request, ReceiveRequest):
+            self.get_or_open_session(request.party, request.scope, request.flow_name)
+            return self._park_receive(request)
+        if isinstance(request, VerifyTxRequest):
+            if self.replaying:
+                # Completed before the crash — replay the recorded outcome.
+                return self._consume_replay_entry()
+            # Crashed (or first reached) while pending: (re-)enqueue.
+            self.state = _WAIT_VERIFY
+            self.manager._enqueue_verify(self, request)
+            return _PARKED
+        raise FlowException(f"flow yielded unknown request {request!r}")
+
+    def _consume_replay_entry(self):
+        entry = self.resolved[self._replay_cursor]
+        self._replay_cursor += 1
+        return entry
+
+    def _park_receive(self, request: ReceiveRequest):
+        if self.replaying:
+            entry = self.resolved[self._replay_cursor]
+            self._replay_cursor += 1
+            return entry
+        session = self.sessions[f"{request.scope}|{request.party.name}"]
+        if session.receive_buffer:
+            payload = session.receive_buffer.pop(0)
+            return self._resolve_received(request, payload)
+        self.state = _WAIT_RECEIVE
+        self.waiting_on = request
+        self.manager._checkpoint(self)
+        return _PARKED
+
+    def _resolve_received(self, request: ReceiveRequest, payload):
+        """Type-check an inbound payload and produce the feed entry."""
+        if isinstance(payload, _SessionEndedMarker):
+            err = FlowSessionException(
+                f"Counterparty flow on {request.party} has ended before sending data"
+            )
+            self._record("e", err=err)
+            self.manager._checkpoint(self)
+            return ("e", type(err).__name__, str(err))
+        if not isinstance(payload, request.expected_type):
+            err = FlowSessionException(
+                f"Expected {request.expected_type.__name__}, got {type(payload).__name__}"
+            )
+            self._record("e", err=err)
+            self.manager._checkpoint(self)
+            return ("e", type(err).__name__, str(err))
+        value = UntrustworthyData(payload)
+        self._record("v", value)  # wrapped, so replay feeds the same shape
+        self.manager._checkpoint(self)
+        return ("v", value)
+
+    # -- events from the manager ------------------------------------------
+
+    def deliver_session_payload(self, session: FlowSession, payload) -> None:
+        if (
+            self.state == _WAIT_RECEIVE
+            and self.waiting_on is not None
+            and self.waiting_on.party.name == session.party.name
+            and self.waiting_on.scope == session.scope
+        ):
+            request, self.waiting_on = self.waiting_on, None
+            self.state = _RUNNABLE
+            self.pending_value = self._resolve_received(request, payload)
+            self.manager._mark_runnable(self)
+        else:
+            session.receive_buffer.append(payload)
+            self.manager._checkpoint(self)
+
+    def deliver_verify_result(self, ok: bool, error: BaseException | None) -> None:
+        assert self.state == _WAIT_VERIFY
+        self.state = _RUNNABLE
+        if ok:
+            self._record("v", None)
+            self.pending_value = ("v", None)
+        else:
+            self._record("e", err=error)
+            self.pending_value = ("e", type(error).__name__, str(error))
+        self.manager._checkpoint(self)
+        self.manager._mark_runnable(self)
+
+    def session_confirmed(self, session: FlowSession) -> None:
+        session.state = "open"
+        for payload in session.outgoing_buffer:
+            self.manager._send_session_message(
+                session.party, session.peer_id, SessionData(session.peer_id, payload)
+            )
+            session.send_count += 1
+        session.outgoing_buffer.clear()
+        self.manager._checkpoint(self)
+
+    def session_rejected(self, session: FlowSession, reason: str) -> None:
+        session.state = "ended"
+        self.deliver_session_payload(session, _SESSION_ENDED)
+
+    def session_ended(self, session: FlowSession) -> None:
+        session.state = "ended"
+        if (
+            self.state == _WAIT_RECEIVE
+            and self.waiting_on is not None
+            and self.waiting_on.party.name == session.party.name
+            and self.waiting_on.scope == session.scope
+        ):
+            self.deliver_session_payload(session, _SESSION_ENDED)
+
+    # -- completion --------------------------------------------------------
+
+    def _finish(self, result) -> None:
+        self.state = _DONE
+        self.manager._flow_finished(self)
+        self.future.set_result(result)
+
+    def _fail(self, exc: BaseException) -> None:
+        self.state = _DONE
+        logger.debug("flow %s failed: %s", self.run_id.hex()[:8], exc)
+        self.manager._flow_finished(self)
+        self.future.set_exception(exc)
+
+    def to_checkpoint(self) -> Checkpoint:
+        return Checkpoint(
+            run_id=self.run_id,
+            flow_name=type(self.logic).flow_name or type(self.logic).__qualname__,
+            flow_args=self.logic.checkpoint_args(),
+            resolved=tuple(self.resolved),
+            sessions=tuple(s.to_checkpoint() for s in self.sessions.values()),
+            next_session_seq=self.next_session_seq,
+        )
+
+
+class _Parked:
+    pass
+
+
+_PARKED = _Parked()
+
+
+@register
+@dataclass(frozen=True)
+class _SessionEndedMarker:
+    """Sentinel buffered when a peer ends/rejects; serializable because it can
+    sit in a checkpointed receive buffer."""
+
+
+_SESSION_ENDED = _SessionEndedMarker()
+
+
+def _rebuild_exception(entry) -> BaseException:
+    _, type_name, message = entry
+    if type_name in ("SignatureError", "SignaturesMissingException"):
+        return SignatureError(message)
+    if type_name == "FlowSessionException":
+        return FlowSessionException(message)
+    if type_name == "UniquenessException":
+        # Re-raised without the structured conflict (kept in the message).
+        return FlowException(message)
+    return FlowException(f"{type_name}: {message}")
+
+
+# ---------------------------------------------------------------------------
+# The manager
+# ---------------------------------------------------------------------------
+
+
+class StateMachineManager:
+    """Owns every live flow on a node; single-threaded cooperative pump."""
+
+    def __init__(
+        self,
+        service_hub,
+        messaging: MessagingService,
+        checkpoint_storage: CheckpointStorage | None = None,
+        verifier: BatchVerifier | None = None,
+        our_identity: Party | None = None,
+        token_context: "TokenContext | None" = None,
+        defer_verify: bool = False,
+    ):
+        # defer_verify: leave VerifyTxRequests queued until the scheduler
+        # calls flush_pending_verifies() — lets a node accumulate sig checks
+        # across ALL messages delivered in a scheduling round, maximising the
+        # TPU batch (the max-wait micro-batching of SURVEY.md §7 stage 6).
+        self.defer_verify = defer_verify
+        self.service_hub = service_hub
+        self.messaging = messaging
+        self.checkpoint_storage = (
+            checkpoint_storage if checkpoint_storage is not None
+            else InMemoryCheckpointStorage()  # ("or" would drop an empty storage)
+        )
+        self.token_context = token_context or TokenContext()
+        self.verifier = verifier or get_verifier()
+        self.our_identity = our_identity or (
+            service_hub.my_info.legal_identity if service_hub and service_hub.my_info else None
+        )
+        self.flows: dict[bytes, FlowStateMachine] = {}
+        self._sessions_by_local_id: dict[int, tuple[FlowStateMachine, FlowSession]] = {}
+        self._session_handlers: dict[int, Any] = {}
+        self._flow_factories: dict[str, Callable[[Party], FlowLogic]] = {}
+        self._runnable: list[FlowStateMachine] = []
+        self._verify_queue: list[tuple[FlowStateMachine, VerifyTxRequest]] = []
+        self._pumping = False
+        self.changes: list[tuple[str, bytes]] = []  # (event, run_id) feed
+        # Metrics (reference: StateMachineManager.kt:105-113)
+        self.metrics = {"started": 0, "finished": 0, "checkpointing_rate": 0,
+                        "verify_batches": 0, "verify_sigs": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.messaging.add_message_handler(
+            SESSION_TOPIC, DEFAULT_SESSION_ID, self._on_session_init_message
+        )
+        self._restore_checkpoints()
+        self._pump()
+
+    def register_flow_initiator(
+        self, initiator_flow_name: str, factory: Callable[[Party], FlowLogic]
+    ) -> None:
+        """When a SessionInit for `initiator_flow_name` arrives, build the
+        responding flow with the initiating party
+        (reference: ServiceHubInternal.registerFlowInitiator)."""
+        self._flow_factories[initiator_flow_name] = factory
+
+    def add(self, logic: FlowLogic) -> FlowHandle:
+        """Start a new flow (reference: StateMachineManager.kt:381-397)."""
+        # Random run ids: a counter would restart at 0 after a crash and
+        # collide with checkpoint-restored flows.
+        run_id = os.urandom(16)
+        fsm = FlowStateMachine(self, logic, run_id)
+        self.flows[run_id] = fsm
+        self.metrics["started"] += 1
+        self._checkpoint(fsm)
+        self._mark_runnable(fsm)
+        self.changes.append(("add", run_id))
+        self._pump()
+        return FlowHandle(run_id, fsm.future, logic)
+
+    @property
+    def in_flight_count(self) -> int:
+        return len(self.flows)
+
+    # -- checkpoint & restore ---------------------------------------------
+
+    def _checkpoint(self, fsm: FlowStateMachine) -> None:
+        if fsm.state == _DONE:
+            return
+        self.metrics["checkpointing_rate"] += 1
+        try:
+            with self.token_context:
+                blob = serialize(fsm.to_checkpoint()).bytes
+            self.checkpoint_storage.update_checkpoint(fsm.run_id, blob)
+        except Exception as e:
+            # Unserializable flow state is a programming error; fail loudly.
+            raise FlowException(f"cannot checkpoint flow: {e}") from e
+
+    def _restore_checkpoints(self) -> None:
+        """Rebuild flows by deterministic replay
+        (reference: StateMachineManager.kt:190-226)."""
+        for blob in self.checkpoint_storage.checkpoints():
+            with self.token_context:
+                cp = deserialize(blob)
+            try:
+                logic = flow_registry.create(cp.flow_name, tuple(cp.flow_args))
+            except FlowException:
+                logger.error("dropping checkpoint for unknown flow %s", cp.flow_name)
+                continue
+            restored = [FlowSession.from_checkpoint(sc) for sc in cp.sessions]
+            sessions = {s.key: s for s in restored}
+            fsm = FlowStateMachine(
+                self,
+                logic,
+                cp.run_id,
+                resolved=list(cp.resolved),
+                sessions=sessions,
+                next_session_seq=cp.next_session_seq,
+            )
+            for session in sessions.values():
+                self._register_session(fsm, session)
+            self.flows[cp.run_id] = fsm
+            self._mark_runnable(fsm)
+            self.changes.append(("restore", cp.run_id))
+
+    # -- scheduling --------------------------------------------------------
+
+    def _mark_runnable(self, fsm: FlowStateMachine) -> None:
+        if fsm not in self._runnable and fsm.state != _DONE:
+            fsm.state = _RUNNABLE
+            self._runnable.append(fsm)
+
+    def _pump(self) -> None:
+        """Run flows until everything is parked; then flush verify batches.
+        Re-entrant calls fold into the outer pump."""
+        if self._pumping:
+            return
+        self._pumping = True
+        try:
+            while True:
+                while self._runnable:
+                    fsm = self._runnable.pop(0)
+                    if fsm.state != _DONE:
+                        fsm.step()
+                if self._verify_queue and not self.defer_verify:
+                    self._flush_verify_batch()
+                    continue
+                break
+        finally:
+            self._pumping = False
+
+    def flush_pending_verifies(self) -> int:
+        """Flush the accumulated verify micro-batch (deferred mode); returns
+        the number of requests satisfied."""
+        n = len(self._verify_queue)
+        if n:
+            self._flush_verify_batch()
+            self._pump()
+        return n
+
+    # -- the verification pump (TPU seam) ---------------------------------
+
+    def _enqueue_verify(self, fsm: FlowStateMachine, request: VerifyTxRequest) -> None:
+        self._verify_queue.append((fsm, request))
+
+    def _flush_verify_batch(self) -> None:
+        """One batched kernel call covering every parked VerifyTxRequest."""
+        batch, self._verify_queue = self._verify_queue, []
+        jobs: list[VerifyJob] = []
+        spans: list[tuple[FlowStateMachine, VerifyTxRequest, int, int]] = []
+        for fsm, request in batch:
+            sigs = request.stx.sigs
+            start = len(jobs)
+            jobs.extend(
+                VerifyJob(
+                    pubkey=sig.by.encoded,
+                    message=request.stx.id.bytes,
+                    sig=sig.bytes,
+                )
+                for sig in sigs
+            )
+            spans.append((fsm, request, start, len(jobs)))
+        ok = self.verifier.verify_batch(jobs) if jobs else []
+        self.metrics["verify_batches"] += 1
+        self.metrics["verify_sigs"] += len(jobs)
+        for fsm, request, start, end in spans:
+            fsm_ok, error = True, None
+            if not all(ok[start:end]):
+                fsm_ok = False
+                bad = [
+                    request.stx.sigs[i - start].by
+                    for i in range(start, end)
+                    if not ok[i]
+                ]
+                error = SignatureError(f"Signature did not match for keys: {bad}")
+            else:
+                # Math passed; check completeness on the host (cheap).
+                try:
+                    missing = request.stx.get_missing_signatures()
+                    needed = missing - set(request.allowed_to_be_missing)
+                    if needed:
+                        from ..transactions.signed import SignaturesMissingException
+
+                        fsm_ok = False
+                        error = SignaturesMissingException(
+                            needed, [], request.stx.id
+                        )
+                except Exception as e:
+                    fsm_ok, error = False, e
+            fsm.deliver_verify_result(fsm_ok, error)
+
+    # -- messaging ---------------------------------------------------------
+
+    def _register_session(self, fsm: FlowStateMachine, session: FlowSession) -> None:
+        self._sessions_by_local_id[session.local_id] = (fsm, session)
+        # Route future messages addressed to this session id.
+        registration = self.messaging.add_message_handler(
+            SESSION_TOPIC, session.local_id, self._on_existing_session_message
+        )
+        self._session_handlers[session.local_id] = registration
+
+    def _send_session_message(self, party: Party, session_id: int, payload) -> None:
+        node = self.service_hub.network_map_cache.get_node_by_legal_identity(party)
+        if node is None:
+            raise FlowException(f"don't know where to send to {party}")
+        self.messaging.send(
+            TopicSession(SESSION_TOPIC, session_id or DEFAULT_SESSION_ID),
+            serialize(payload).bytes,
+            node.address,
+        )
+
+    def _on_session_init_message(self, message: Message) -> None:
+        try:
+            payload = deserialize(message.data)
+        except Exception as e:
+            # Hostile/corrupt bytes must not halt the delivery pump.
+            logger.warning("dropping undecodable init message: %s", e)
+            return
+        if not isinstance(payload, SessionInit):
+            logger.warning("non-init message on init session: %r", payload)
+            return
+        factory = self._flow_factories.get(payload.flow_name)
+        initiator = payload.initiator_party
+        if factory is None:
+            self._send_session_message(
+                initiator,
+                payload.initiator_session_id,
+                SessionReject(
+                    payload.initiator_session_id,
+                    f"no flow registered for {payload.flow_name}",
+                ),
+            )
+            self._pump()
+            return
+        logic = factory(initiator)
+        run_id = os.urandom(16)
+        fsm = FlowStateMachine(self, logic, run_id)
+        self.flows[run_id] = fsm
+        self.metrics["started"] += 1
+        local_id = fsm._session_id(fsm.next_session_seq)
+        fsm.next_session_seq += 1
+        session = fsm.open_initiated_session(
+            initiator, local_id, payload.initiator_session_id
+        )
+        self._send_session_message(
+            initiator,
+            payload.initiator_session_id,
+            SessionConfirm(payload.initiator_session_id, local_id),
+        )
+        if payload.first_payload is not None:
+            session.receive_buffer.append(payload.first_payload)
+        self._checkpoint(fsm)
+        self._mark_runnable(fsm)
+        self.changes.append(("add", run_id))
+        self._pump()
+
+    def _on_existing_session_message(self, message: Message) -> None:
+        entry = self._sessions_by_local_id.get(message.topic_session.session_id)
+        if entry is None:
+            logger.warning("message for unknown session %s", message.topic_session)
+            return
+        fsm, session = entry
+        try:
+            payload = deserialize(message.data)
+        except Exception as e:
+            logger.warning("dropping undecodable session message: %s", e)
+            return
+        if isinstance(payload, SessionConfirm):
+            session.peer_id = payload.initiated_session_id
+            fsm.session_confirmed(session)
+        elif isinstance(payload, SessionReject):
+            fsm.session_rejected(session, payload.error_message)
+        elif isinstance(payload, SessionData):
+            fsm.deliver_session_payload(session, payload.payload)
+        elif isinstance(payload, SessionEnd):
+            fsm.session_ended(session)
+        else:
+            logger.warning("unknown session payload %r", payload)
+        self._pump()
+
+    # -- completion --------------------------------------------------------
+
+    def _flow_finished(self, fsm: FlowStateMachine) -> None:
+        self.flows.pop(fsm.run_id, None)
+        self.checkpoint_storage.remove_checkpoint(fsm.run_id)
+        self.metrics["finished"] += 1
+        self.changes.append(("remove", fsm.run_id))
+        for session in fsm.sessions.values():
+            self._sessions_by_local_id.pop(session.local_id, None)
+            registration = self._session_handlers.pop(session.local_id, None)
+            if registration is not None:
+                try:
+                    self.messaging.remove_message_handler(registration)
+                except Exception:
+                    pass
+            if session.state == "open" and session.peer_id is not None:
+                try:
+                    self._send_session_message(
+                        session.party, session.peer_id, SessionEnd(session.peer_id)
+                    )
+                except FlowException:
+                    pass
+            session.state = "ended"

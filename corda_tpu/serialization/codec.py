@@ -177,6 +177,25 @@ def _encode(out: bytearray, value: Any) -> None:
         for e in encs:
             out.extend(e)
     else:
+        from .tokens import SerializeAsToken, current_token_context
+
+        if isinstance(value, SerializeAsToken):
+            # Long-lived services become named tokens in checkpoints
+            # (reference: SerializationToken.kt:25-133). Valid only inside an
+            # active TokenContext.
+            ctx = current_token_context()
+            if ctx is None:
+                raise TypeError(
+                    f"{type(value).__qualname__} is a service token; it can only be "
+                    "serialized inside a checkpoint TokenContext"
+                )
+            out.append(_TAG_OBJECT)
+            raw = b"__svc_token__"
+            _write_varint(out, len(raw))
+            out.extend(raw)
+            _write_varint(out, 1)
+            _encode(out, value.token_name)
+            return
         cls = type(value)
         wire_name = _BY_TYPE.get(cls)
         if wire_name is None:
@@ -247,6 +266,22 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         n, pos = _read_varint(data, pos)
         wire_name = data[pos : pos + n].decode("utf-8")
         pos += n
+        if wire_name == "__svc_token__":
+            from .tokens import current_token_context
+
+            nfields, pos = _read_varint(data, pos)
+            if nfields != 1:
+                raise DeserializationError("malformed service token")
+            token_name, pos = _decode(data, pos)
+            ctx = current_token_context()
+            if ctx is None:
+                raise DeserializationError(
+                    f"service token {token_name!r} outside a TokenContext"
+                )
+            try:
+                return ctx.resolve(token_name), pos
+            except KeyError as e:
+                raise DeserializationError(str(e)) from e
         cls = _BY_NAME.get(wire_name)
         if cls is None:
             raise DeserializationError(f"type {wire_name!r} is not whitelisted")

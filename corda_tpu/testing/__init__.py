@@ -1,0 +1,25 @@
+"""Test infrastructure: dummy contracts, canned identities, mock services,
+deterministic in-memory network (MockNetwork), ledger DSL."""
+
+from .dummies import (  # noqa: F401
+    DummyContract,
+    DummySingleOwnerState,
+    DummyMultiOwnerState,
+    DUMMY_PROGRAM_ID,
+    DummyCreate,
+    DummyMove,
+)
+from .identities import (  # noqa: F401
+    ALICE,
+    ALICE_KEY,
+    BOB,
+    BOB_KEY,
+    CHARLIE,
+    CHARLIE_KEY,
+    DUMMY_NOTARY,
+    DUMMY_NOTARY_KEY,
+    MEGA_CORP,
+    MEGA_CORP_KEY,
+    MINI_CORP,
+    MINI_CORP_KEY,
+)

@@ -1,0 +1,222 @@
+"""MockNetwork: whole-network multi-node tests in one process.
+
+Capability match for the reference's MockNetwork/MockNode (reference:
+test-utils/src/main/kotlin/net/corda/testing/node/MockNode.kt:47-160) — the
+survey's load-bearing testing idea (SURVEY.md §4): real node wiring (services,
+state machine manager, notary) with fakes swapped in — the deterministic
+manually-pumped InMemoryMessagingNetwork, in-memory storage/uniqueness, and a
+shared network-map view. Multi-party protocols, crash/restart recovery and
+double-spend rejection all run deterministically with no real network.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..crypto.keys import KeyPair
+from ..crypto.party import Party
+from ..crypto.provider import BatchVerifier
+from ..flows.api import FlowLogic
+from ..node.messaging.inmem import InMemoryMessagingNetwork
+from ..node.services.api import (
+    NodeInfo,
+    ServiceHub,
+    ServiceInfo,
+    StorageService,
+    UniquenessProvider,
+    SIMPLE_NOTARY,
+    VALIDATING_NOTARY,
+)
+from ..node.services.inmemory import (
+    InMemoryAttachmentStorage,
+    InMemoryNetworkMapCache,
+    InMemoryTransactionStorage,
+    InMemoryUniquenessProvider,
+    InMemoryIdentityService,
+    NodeVaultService,
+    SimpleKeyManagementService,
+)
+from ..node.statemachine import (
+    CheckpointStorage,
+    FlowHandle,
+    InMemoryCheckpointStorage,
+    StateMachineManager,
+)
+
+
+class MockNode:
+    """One in-process node: real services + SMM over the fake network."""
+
+    def __init__(
+        self,
+        network: "MockNetwork",
+        name: str,
+        key: KeyPair,
+        advertised_services: tuple[ServiceInfo, ...] = (),
+        verifier: BatchVerifier | None = None,
+        checkpoint_storage: CheckpointStorage | None = None,
+        reattach_address=None,
+    ):
+        self.network = network
+        self.name = name
+        self.key = key
+        self.identity = Party.of(name, key.public)
+        if reattach_address is not None:
+            # Crash recovery: rebind to the same durable address so queued
+            # store-and-forward messages reach the reborn node.
+            self.messaging = network.messaging_network.reattach(reattach_address)
+        else:
+            self.messaging = network.messaging_network.create_node_messaging(name)
+        self.info = NodeInfo(
+            address=self.messaging.my_address,
+            legal_identity=self.identity,
+            advertised_services=advertised_services,
+        )
+        self.checkpoint_storage = (
+            checkpoint_storage if checkpoint_storage is not None
+            else InMemoryCheckpointStorage()
+        )
+
+        key_service = SimpleKeyManagementService([key])
+        self.services = ServiceHub(
+            identity_service=network.identity_service,
+            key_management_service=key_service,
+            storage_service=StorageService(
+                validated_transactions=InMemoryTransactionStorage(),
+                attachments=InMemoryAttachmentStorage(),
+            ),
+            vault_service=NodeVaultService(
+                lambda: set(key_service.keys.keys())
+            ),
+            network_map_cache=network.network_map_cache,
+            my_info=self.info,
+        )
+        self.smm = StateMachineManager(
+            service_hub=self.services,
+            messaging=self.messaging,
+            checkpoint_storage=self.checkpoint_storage,
+            verifier=verifier or network.verifier,
+            our_identity=self.identity,
+            defer_verify=True,  # batch across the whole scheduling round
+        )
+        self.uniqueness_provider: UniquenessProvider | None = None
+        self.notary_service = None
+
+    def start(self) -> "MockNode":
+        from ..flows.data_vending import install_data_vending
+
+        install_data_vending(self.smm)
+        self.smm.start()
+        return self
+
+    def start_flow(self, logic: FlowLogic) -> FlowHandle:
+        return self.smm.add(logic)
+
+    def register_initiated_flow(
+        self, initiator_name: str, factory: Callable[[Party], FlowLogic]
+    ) -> None:
+        self.smm.register_flow_initiator(initiator_name, factory)
+
+    def record_transaction(self, stx) -> None:
+        self.services.record_transactions([stx])
+
+    def stop(self) -> None:
+        self.messaging.stop()
+
+    def restart(self) -> "MockNode":
+        """Crash/recover: a fresh node with the same durable state — identity
+        key, checkpoint storage, storage — then checkpoint-restore resumes
+        mid-protocol flows (reference: TwoPartyTradeProtocolTests mid-flow
+        restart)."""
+        self.stop()
+        replacement = MockNode(
+            self.network,
+            self.name,
+            self.key,
+            self.info.advertised_services,
+            checkpoint_storage=self.checkpoint_storage,
+            reattach_address=self.messaging.my_address,
+        )
+        # Durable storage survives the crash.
+        replacement.services.storage_service = self.services.storage_service
+        replacement.services.vault_service = self.services.vault_service
+        replacement.uniqueness_provider = self.uniqueness_provider
+        self.network._replace_node(self, replacement)
+        if self.notary_service is not None:
+            from ..node.services.notary import rebuild_notary_service
+
+            replacement.notary_service = rebuild_notary_service(
+                self.notary_service, replacement
+            )
+        replacement.start()
+        return replacement
+
+
+class MockNetwork:
+    """Factory + shared fabric for MockNodes."""
+
+    def __init__(self, verifier: BatchVerifier | None = None):
+        self.messaging_network = InMemoryMessagingNetwork()
+        self.identity_service = InMemoryIdentityService()
+        self.network_map_cache = InMemoryNetworkMapCache()
+        self.verifier = verifier
+        self.nodes: list[MockNode] = []
+        self._key_counter = 1000
+
+    def _next_key(self) -> KeyPair:
+        self._key_counter += 1
+        return KeyPair.generate(self._key_counter.to_bytes(32, "little"))
+
+    def create_node(
+        self,
+        name: str,
+        key: KeyPair | None = None,
+        advertised_services: tuple[ServiceInfo, ...] = (),
+        start: bool = True,
+    ) -> MockNode:
+        node = MockNode(
+            self, name, key or self._next_key(), tuple(advertised_services)
+        )
+        self.nodes.append(node)
+        self.identity_service.register_identity(node.identity)
+        self.network_map_cache.add_node(node.info)
+        if start:
+            node.start()
+        return node
+
+    def create_notary_node(
+        self, name: str = "Notary Service", validating: bool = True
+    ) -> MockNode:
+        from ..node.services.notary import SimpleNotaryService, ValidatingNotaryService
+
+        service_type = VALIDATING_NOTARY if validating else SIMPLE_NOTARY
+        node = self.create_node(
+            name, advertised_services=(ServiceInfo(service_type),), start=False
+        )
+        node.uniqueness_provider = InMemoryUniquenessProvider()
+        cls = ValidatingNotaryService if validating else SimpleNotaryService
+        node.notary_service = cls(node.smm, node.services, node.identity, node.key, node.uniqueness_provider)
+        node.start()
+        return node
+
+    def _replace_node(self, old: MockNode, new: MockNode) -> None:
+        self.nodes[self.nodes.index(old)] = new
+        self.identity_service.register_identity(new.identity)
+        self.network_map_cache.add_node(new.info)
+
+    def run_network(self, max_messages: int = 100_000) -> int:
+        """Pump until quiescent: drain all in-flight messages, then flush
+        every node's accumulated verify micro-batch, repeat. Message drains
+        between flushes are what make the batches wide."""
+        delivered = 0
+        while True:
+            delivered += self.messaging_network.run(max_messages)
+            flushed = sum(node.smm.flush_pending_verifies() for node in self.nodes)
+            if flushed == 0 and self.messaging_network.in_flight_count == 0:
+                return delivered
+
+    def stop_nodes(self) -> None:
+        for node in self.nodes:
+            node.stop()
+        self.messaging_network.stop()

@@ -1,0 +1,137 @@
+"""SignedTransaction: the wire payload plus signatures — and the hot path.
+
+Capability match for the reference's SignedTransaction (reference:
+core/src/main/kotlin/net/corda/core/transactions/SignedTransaction.kt). The
+reference's checkSignaturesAreValid is a sequential per-signature loop
+(SignedTransaction.kt:83-87) — THE notary hot loop this framework re-designs:
+here every signature check goes through the pluggable BatchVerifier
+(corda_tpu/crypto/provider.py), so one transaction's signatures verify as a
+batch, and the state machine manager aggregates *across* transactions into
+TPU-sized micro-batches (StateMachineManager._flush_verify_batch in
+corda_tpu/node/statemachine.py).
+
+The id is the WireTransaction Merkle root, so adding/removing signatures never
+changes identity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Iterable, Sequence
+
+from ..crypto.composite import CompositeKey
+from ..crypto.hashes import SecureHash
+from ..crypto.keys import DigitalSignature, SignatureError, by_keys
+from ..crypto.provider import VerifyJob, get_verifier
+from ..serialization.codec import SerializedBytes, register
+from .wire import WireTransaction
+
+
+class SignaturesMissingException(SignatureError):
+    """Required signatures absent (SignedTransaction.kt:41-46)."""
+
+    def __init__(self, missing: set[CompositeKey], descriptions: list[str], id: SecureHash):
+        super().__init__(
+            f"Missing signatures for {descriptions} on transaction {id.prefix_chars()} "
+            f"for {sorted(missing, key=repr)}"
+        )
+        self.missing = missing
+        self.descriptions = descriptions
+        self.id = id
+
+
+@register
+@dataclass(frozen=True)
+class SignedTransaction:
+    """Serialized WireTransaction + signatures over its id."""
+
+    tx_bits: SerializedBytes
+    sigs: tuple[DigitalSignature.WithKey, ...]
+    id: SecureHash
+
+    def __post_init__(self):
+        object.__setattr__(self, "sigs", tuple(self.sigs))
+        if not self.sigs:
+            raise ValueError("SignedTransaction requires at least one signature")
+
+    @staticmethod
+    def of(wtx: WireTransaction, sigs: Sequence[DigitalSignature.WithKey]) -> "SignedTransaction":
+        return SignedTransaction(tx_bits=wtx.serialized, sigs=tuple(sigs), id=wtx.id)
+
+    @property
+    def tx(self) -> WireTransaction:
+        """Deserialized payload; id cross-checked (SignedTransaction.kt:33-37)."""
+        cached = getattr(self, "_tx", None)
+        if cached is None:
+            cached = self.tx_bits.deserialize()
+            if cached.id != self.id:
+                raise ValueError(
+                    "Supplied transaction ID does not match deserialized transaction's ID"
+                )
+            object.__setattr__(self, "_tx", cached)
+        return cached
+
+    # -- signature verification (the hot path) ----------------------------
+
+    def check_signatures_are_valid(self) -> None:
+        """Mathematically validate every attached signature over the tx id.
+
+        The reference loops one signature at a time
+        (SignedTransaction.kt:83-87); here the whole set goes to the
+        BatchVerifier in one call.
+        """
+        jobs = [
+            VerifyJob(pubkey=sig.by.encoded, message=self.id.bytes, sig=sig.bytes)
+            for sig in self.sigs
+        ]
+        ok = get_verifier().verify_batch(jobs)
+        if not all(ok):
+            bad = [self.sigs[i].by for i in range(len(jobs)) if not ok[i]]
+            raise SignatureError(f"Signature did not match for keys: {bad}")
+
+    def verify_signatures(self, *allowed_to_be_missing: CompositeKey) -> WireTransaction:
+        """Check validity AND completeness of signatures
+        (SignedTransaction.kt:59-74); returns the verified WireTransaction."""
+        self.check_signatures_are_valid()
+        missing = self.get_missing_signatures()
+        if missing:
+            needed = missing - set(allowed_to_be_missing)
+            if needed:
+                raise SignaturesMissingException(
+                    needed, self._missing_key_descriptions(needed), self.id
+                )
+        if self.tx.id != self.id:
+            raise ValueError("id mismatch")
+        return self.tx
+
+    def get_missing_signatures(self) -> set[CompositeKey]:
+        sig_keys = by_keys(self.sigs)
+        return {ck for ck in self.tx.must_sign if not ck.is_fulfilled_by(sig_keys)}
+
+    def _missing_key_descriptions(self, missing: set[CompositeKey]) -> list[str]:
+        out = []
+        for cmd in self.tx.commands:
+            if any(s in missing for s in cmd.signers):
+                out.append(str(cmd))
+        if self.tx.notary is not None and self.tx.notary.owning_key in missing:
+            out.append("notary")
+        return out
+
+    # -- composition -------------------------------------------------------
+
+    def with_additional_signature(self, sig: DigitalSignature.WithKey) -> "SignedTransaction":
+        return replace(self, sigs=self.sigs + (sig,))
+
+    def with_additional_signatures(
+        self, sig_list: Iterable[DigitalSignature.WithKey]
+    ) -> "SignedTransaction":
+        return replace(self, sigs=self.sigs + tuple(sig_list))
+
+    def __add__(self, sig):
+        if isinstance(sig, DigitalSignature.WithKey):
+            return self.with_additional_signature(sig)
+        return self.with_additional_signatures(sig)
+
+    def to_ledger_transaction(self, services):
+        """verify_signatures + resolve dependencies (SignedTransaction.kt:131-137)."""
+        return self.verify_signatures().to_ledger_transaction(services)

@@ -1,0 +1,333 @@
+"""L1 transaction layer: ids, builder, signature checking, platform rules,
+tear-offs.
+
+Mirrors the reference's TransactionTests / WireTransaction usage patterns
+(reference: core/src/test/kotlin/net/corda/core/contracts/TransactionTests.kt,
+PartialMerkleTreeTest.kt tear-off sections).
+"""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.contracts import (
+    Command,
+    StateAndRef,
+    StateRef,
+    Timestamp,
+    TransactionState,
+    NotaryChangeInWrongTransactionType,
+    SignersMissing,
+    InvalidNotaryChange,
+    ContractRejection,
+    TransactionMissingEncumbranceException,
+)
+from corda_tpu.crypto import SecureHash, SignatureError
+from corda_tpu.serialization.codec import deserialize, register, serialize
+from corda_tpu.testing import (
+    ALICE,
+    ALICE_KEY,
+    BOB,
+    BOB_KEY,
+    DUMMY_NOTARY,
+    DUMMY_NOTARY_KEY,
+    MEGA_CORP,
+    DummyContract,
+    DummyCreate,
+    DummyMove,
+    DummySingleOwnerState,
+)
+from corda_tpu.transactions import (
+    LedgerTransaction,
+    SignaturesMissingException,
+    SignedTransaction,
+    TransactionBuilder,
+    FilterFuns,
+    FilteredTransaction,
+    NotaryChangeTransactionType,
+)
+from corda_tpu.transactions.builder import NotaryChangeBuilder
+
+
+def issue_tx() -> TransactionBuilder:
+    return DummyContract.generate_initial(ALICE.ref(b"\x01"), 42, DUMMY_NOTARY)
+
+
+def move_tx() -> TransactionBuilder:
+    """A move spends an input, so the notary key lands in must_sign."""
+    prior = issue_tx().to_wire_transaction().out_ref(0)
+    return DummyContract.move(prior, BOB.owning_key)
+
+
+class TestWireTransaction:
+    def test_id_is_stable_over_serialization(self):
+        wtx = issue_tx().to_wire_transaction()
+        restored = deserialize(serialize(wtx).bytes)
+        assert restored.id == wtx.id
+        assert restored == wtx
+
+    def test_id_changes_with_content(self):
+        a = DummyContract.generate_initial(ALICE.ref(b"\x01"), 42, DUMMY_NOTARY)
+        b = DummyContract.generate_initial(ALICE.ref(b"\x01"), 43, DUMMY_NOTARY)
+        assert a.to_wire_transaction().id != b.to_wire_transaction().id
+
+    def test_id_independent_of_signatures(self):
+        builder = issue_tx()
+        unsigned_id = builder.to_wire_transaction().id
+        builder.sign_with(ALICE_KEY)
+        stx = builder.to_signed_transaction(check_sufficient_signatures=False)
+        assert stx.id == unsigned_id
+
+    def test_inputs_require_notary(self):
+        from corda_tpu.transactions.wire import WireTransaction
+
+        with pytest.raises(ValueError):
+            WireTransaction(inputs=(StateRef(SecureHash.zero(), 0),), notary=None)
+
+    def test_timestamp_requires_notary(self):
+        from corda_tpu.transactions.wire import WireTransaction
+
+        with pytest.raises(ValueError):
+            WireTransaction(timestamp=Timestamp.around(10**15, 10**6))
+
+    def test_out_ref(self):
+        wtx = issue_tx().to_wire_transaction()
+        ref = wtx.out_ref(0)
+        assert ref.ref == StateRef(wtx.id, 0)
+        assert ref.state.data.magic_number == 42
+
+
+class TestSignedTransaction:
+    def test_verify_signatures_happy_path(self):
+        builder = issue_tx()
+        builder.sign_with(ALICE_KEY).sign_with(DUMMY_NOTARY_KEY)
+        stx = builder.to_signed_transaction()
+        wtx = stx.verify_signatures()
+        assert wtx.id == stx.id
+
+    def test_missing_notary_sig_reported(self):
+        builder = move_tx()
+        builder.sign_with(ALICE_KEY)
+        stx = builder.to_signed_transaction(check_sufficient_signatures=False)
+        with pytest.raises(SignaturesMissingException) as exc:
+            stx.verify_signatures()
+        assert "notary" in exc.value.descriptions
+
+    def test_allowed_to_be_missing(self):
+        builder = move_tx()
+        builder.sign_with(ALICE_KEY)
+        stx = builder.to_signed_transaction(check_sufficient_signatures=False)
+        stx.verify_signatures(DUMMY_NOTARY.owning_key)
+
+    def test_corrupt_signature_rejected(self):
+        builder = issue_tx()
+        builder.sign_with(ALICE_KEY).sign_with(DUMMY_NOTARY_KEY)
+        stx = builder.to_signed_transaction()
+        bad_sig = dataclasses.replace(stx.sigs[0], bytes=b"\x01" * 64)
+        bad = dataclasses.replace(stx, sigs=(bad_sig, stx.sigs[1]))
+        with pytest.raises(SignatureError):
+            bad.verify_signatures()
+
+    def test_wrong_key_signature_rejected(self):
+        builder = issue_tx()
+        builder.sign_with(ALICE_KEY).sign_with(DUMMY_NOTARY_KEY)
+        stx = builder.to_signed_transaction()
+        # Swap the claimed signer: math check must fail.
+        forged = dataclasses.replace(stx.sigs[0], by=BOB.owning_key.single_key)
+        bad = dataclasses.replace(stx, sigs=(forged, stx.sigs[1]))
+        with pytest.raises(SignatureError):
+            bad.verify_signatures()
+
+    def test_composite_key_fulfilment_via_any_member(self):
+        from corda_tpu.crypto import CompositeKey
+
+        cluster = (
+            CompositeKey.Builder()
+            .add_keys(ALICE.owning_key.single_key, BOB.owning_key.single_key)
+            .build(threshold=1)
+        )
+        cluster_party = type(DUMMY_NOTARY)("Cluster", cluster)
+        builder = DummyContract.generate_initial(ALICE.ref(b"\x01"), 7, cluster_party)
+        builder.sign_with(ALICE_KEY)  # command key
+        builder.sign_with(BOB_KEY)  # one cluster member satisfies 1-of-2
+        stx = builder.to_signed_transaction(check_sufficient_signatures=False)
+        stx.verify_signatures()
+
+    def test_sign_requires_all_before_freeze(self):
+        builder = move_tx()
+        builder.sign_with(ALICE_KEY)
+        with pytest.raises(ValueError):
+            builder.to_signed_transaction()  # notary key missing
+
+
+def resolved(builder: TransactionBuilder) -> LedgerTransaction:
+    """Resolve a tx whose inputs came from out_ref()s already in the builder."""
+    wtx = builder.to_wire_transaction()
+    from corda_tpu.contracts import AuthenticatedObject
+
+    return LedgerTransaction(
+        inputs=(),
+        outputs=wtx.outputs,
+        commands=tuple(
+            AuthenticatedObject(c.signers, (), c.value) for c in wtx.commands
+        ),
+        attachments=(),
+        id=wtx.id,
+        notary=wtx.notary,
+        must_sign=wtx.signers,
+        timestamp=wtx.timestamp,
+        type=wtx.type,
+    )
+
+
+class _Rejector(DummyContract):
+    def verify(self, tx):
+        raise ValueError("no")
+
+
+_REJECTOR = _Rejector()
+
+
+@register
+@dataclasses.dataclass(frozen=True)
+class _RejectedState(DummySingleOwnerState):
+    @property
+    def contract(self):
+        return _REJECTOR
+
+
+@register
+@dataclasses.dataclass(frozen=True)
+class _EncumberedState(DummySingleOwnerState):
+    enc: int = 0
+
+    @property
+    def encumbrance(self):
+        return self.enc
+
+
+class TestPlatformRules:
+    def test_general_verify_accepts_dummy(self):
+        resolved(issue_tx()).verify()
+
+    def test_missing_signer_detected(self):
+        ltx = resolved(issue_tx())
+        stripped = dataclasses.replace(ltx, must_sign=())
+        with pytest.raises(SignersMissing):
+            stripped.verify()
+
+    def test_contract_rejection_wraps_cause(self):
+        builder = TransactionBuilder(notary=DUMMY_NOTARY)
+        builder.add_output_state(_RejectedState(1, ALICE.owning_key))
+        builder.add_command(Command(DummyCreate(), (ALICE.owning_key,)))
+        with pytest.raises(ContractRejection):
+            resolved(builder).verify()
+
+    def test_notary_change_in_general_tx_rejected(self):
+        issue = issue_tx()
+        issue.sign_with(ALICE_KEY).sign_with(DUMMY_NOTARY_KEY)
+        prior = issue.to_wire_transaction().out_ref(0)
+
+        move = DummyContract.move(prior, BOB.owning_key)
+        wtx = move.to_wire_transaction()
+        # Tamper: outputs claim a different notary.
+        hijacked = dataclasses.replace(
+            wtx, outputs=(TransactionState(wtx.outputs[0].data, MEGA_CORP),)
+        )
+        ltx = LedgerTransaction(
+            inputs=(StateAndRef(prior.state, prior.ref),),
+            outputs=hijacked.outputs,
+            commands=(),
+            attachments=(),
+            id=hijacked.id,
+            notary=DUMMY_NOTARY,
+            must_sign=hijacked.signers,
+            timestamp=None,
+            type=hijacked.type,
+        )
+        with pytest.raises(NotaryChangeInWrongTransactionType):
+            ltx.verify()
+
+    def test_encumbrance_output_self_reference_rejected(self):
+        builder = TransactionBuilder(notary=DUMMY_NOTARY)
+        builder.add_output_state(_EncumberedState(1, ALICE.owning_key, enc=0))  # self-ref
+        builder.add_command(Command(DummyCreate(), (ALICE.owning_key,)))
+        with pytest.raises(TransactionMissingEncumbranceException):
+            resolved(builder).verify()
+
+
+class TestNotaryChange:
+    def _prior(self) -> StateAndRef:
+        issue = issue_tx()
+        return issue.to_wire_transaction().out_ref(0)
+
+    def test_notary_change_roundtrip(self):
+        prior = self._prior()
+        builder = NotaryChangeBuilder(DUMMY_NOTARY)
+        builder.add_input_state(prior)
+        builder.add_output_state(prior.state.with_notary(MEGA_CORP))
+        wtx = builder.to_wire_transaction()
+        assert isinstance(wtx.type, NotaryChangeTransactionType)
+        # participants auto-added as signers
+        assert ALICE.owning_key in wtx.signers
+        ltx = LedgerTransaction(
+            inputs=(prior,),
+            outputs=wtx.outputs,
+            commands=(),
+            attachments=(),
+            id=wtx.id,
+            notary=wtx.notary,
+            must_sign=wtx.signers,
+            timestamp=None,
+            type=wtx.type,
+        )
+        ltx.verify()
+
+    def test_state_mutation_rejected(self):
+        prior = self._prior()
+        builder = NotaryChangeBuilder(DUMMY_NOTARY)
+        builder.add_input_state(prior)
+        mutated = DummySingleOwnerState(99, ALICE.owning_key)
+        builder.add_output_state(TransactionState(mutated, MEGA_CORP))
+        wtx = builder.to_wire_transaction()
+        ltx = LedgerTransaction(
+            inputs=(prior,),
+            outputs=wtx.outputs,
+            commands=(),
+            attachments=(),
+            id=wtx.id,
+            notary=wtx.notary,
+            must_sign=wtx.signers,
+            timestamp=None,
+            type=wtx.type,
+        )
+        with pytest.raises(InvalidNotaryChange):
+            ltx.verify()
+
+
+class TestFilteredTransaction:
+    def test_tear_off_commands_only(self):
+        builder = issue_tx()
+        wtx = builder.to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(
+            FilterFuns(filter_commands=lambda c: isinstance(c.value, DummyCreate))
+        )
+        assert ftx.verify(wtx.id)
+        assert len(ftx.filtered_leaves.commands) == 1
+        assert ftx.filtered_leaves.outputs == ()
+
+    def test_tear_off_does_not_verify_against_other_tx(self):
+        wtx = issue_tx().to_wire_transaction()
+        other = DummyContract.generate_initial(
+            ALICE.ref(b"\x01"), 43, DUMMY_NOTARY
+        ).to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(
+            FilterFuns(filter_commands=lambda c: True)
+        )
+        assert not ftx.verify(other.id)
+
+    def test_tear_off_roundtrips(self):
+        wtx = issue_tx().to_wire_transaction()
+        ftx = wtx.build_filtered_transaction(FilterFuns(filter_outputs=lambda o: True))
+        restored = deserialize(serialize(ftx).bytes)
+        assert restored.verify(wtx.id)
