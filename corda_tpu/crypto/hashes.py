@@ -3,7 +3,7 @@
 Reference: core/src/main/kotlin/net/corda/core/crypto/SecureHash.kt:33 —
 SHA-256 content addressing used for transaction ids, attachment ids and Merkle
 leaves. Host-side single hashes live here; the batched/tree-structured hashing
-used on the notary hot path is the JAX kernel in corda_tpu/ops/sha256.py.
+used on the notary hot path is the JAX kernel in corda_tpu/ops/sha256_jax.py.
 """
 
 from __future__ import annotations

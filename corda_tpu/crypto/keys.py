@@ -28,6 +28,10 @@ if TYPE_CHECKING:  # circular: party -> composite -> keys
     from .party import Party
 
 
+from ..utils.excheckpoint import register_flow_exception
+
+
+@register_flow_exception
 class SignatureError(Exception):
     """Raised when a signature fails to verify (reference: SignatureException)."""
 

@@ -47,15 +47,18 @@ from typing import Any, Callable, Generator, TYPE_CHECKING
 from ..crypto.composite import CompositeKey
 from ..crypto.party import Party
 from ..serialization.codec import register as register_codec
+from ..utils.excheckpoint import register_flow_exception
 
 if TYPE_CHECKING:
     from ..transactions.signed import SignedTransaction
 
 
+@register_flow_exception
 class FlowException(Exception):
     """Base error for flow failures."""
 
 
+@register_flow_exception
 class FlowSessionException(FlowException):
     """The counterparty session failed: rejected init, unexpected end, or a
     type mismatch on receive."""

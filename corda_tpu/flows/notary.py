@@ -25,7 +25,7 @@ from ..crypto.keys import DigitalSignature, SignatureError
 from ..crypto.party import Party
 from ..crypto.signed_data import SignedData
 from ..serialization.codec import register
-from ..transactions.signed import SignedTransaction
+from ..transactions.signed import SignaturesMissingException, SignedTransaction
 from .api import FlowException, FlowLogic, FlowSessionException, register_flow
 
 
@@ -93,10 +93,24 @@ class NotarySignaturesMissing(NotaryError):
         return f"Missing signatures from: {sorted(self.missing, key=repr)}"
 
 
+from ..utils.excheckpoint import register_flow_exception
+
+
+@register_flow_exception
 class NotaryException(FlowException):
+    """Carries the structured NotaryError through checkpoint replay so
+    restored flows can branch on error kind exactly as live ones do."""
+
     def __init__(self, error: NotaryError):
         super().__init__(f"Error response from Notary - {error}")
         self.error = error
+
+    def __checkpoint_payload__(self):
+        return self.error
+
+    @classmethod
+    def __from_checkpoint__(cls, message, payload):
+        return cls(payload)
 
 
 # ---------------------------------------------------------------------------
@@ -167,10 +181,10 @@ class NotaryServiceFlow(FlowLogic):
 
     def call(self):
         req = yield self.receive(self.other_side, SignRequest)
-        request = req.unwrap()
-        stx = request.tx
-        req_identity = request.caller_identity
         try:
+            request = req.unwrap(self._validate_request)
+            stx = request.tx
+            req_identity = request.caller_identity
             wtx = stx.tx
             self._validate_timestamp(wtx)
             yield from self.before_commit(stx, req_identity)
@@ -179,8 +193,28 @@ class NotaryServiceFlow(FlowLogic):
             result = NotarySuccess(sig)
         except NotaryException as e:
             result = NotaryFailure(e.error)
+        except Exception:
+            # Malformed request payloads (tx_bits/id mismatch, wrong-shaped
+            # message) and unexpected internal errors must produce a
+            # diagnosable notary error, not a generic session death
+            # (reference gap noted at NotaryFlow.kt:96-113). If the primary
+            # session itself is dead, the send below fails and ends the flow.
+            # Logged: an internal error (e.g. a failing commit-log write)
+            # reported to the client as "invalid" needs an operator trail.
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "notary service flow error; replying NotaryTransactionInvalid"
+            )
+            result = NotaryFailure(NotaryTransactionInvalid())
         yield self.send(self.other_side, result)
         return None
+
+    @staticmethod
+    def _validate_request(request):
+        if not isinstance(request, SignRequest):
+            raise ValueError(f"Expected SignRequest, got {type(request).__name__}")
+        return request
 
     def _validate_timestamp(self, wtx) -> None:
         if wtx.timestamp is not None and not self.service.timestamp_checker.is_valid(
@@ -222,14 +256,13 @@ class ValidatingNotaryFlow(NotaryServiceFlow):
                 yield self.verify_signatures_batched(
                     stx, self.service.notary_identity.owning_key
                 )
-            except SignatureError as e:
-                # Distinguish missing vs invalid as the reference does.
-                missing = stx.get_missing_signatures()
-                if missing and "did not match" not in str(e):
-                    raise NotaryException(
-                        NotarySignaturesMissing(frozenset(missing))
-                    ) from e
-                raise
+            except SignaturesMissingException as e:
+                # Typed distinction, preserved across checkpoint replay
+                # (reference branches on the exception type the same way,
+                # ValidatingNotaryFlow.kt:39-45).
+                raise NotaryException(
+                    NotarySignaturesMissing(frozenset(e.missing))
+                ) from e
             wtx = stx.tx
             yield from self.sub_flow(ResolveTransactionsFlow(wtx, self.other_side))
             wtx.to_ledger_transaction(self.service_hub).verify()

@@ -1,0 +1,145 @@
+"""Node configuration + the file-based network map.
+
+Capability match for the reference's HOCON config system (reference:
+node/src/main/kotlin/net/corda/node/services/config/NodeConfiguration.kt:17-79,
+reference.conf defaults, per-node dev configs) re-based on TOML (stdlib
+tomllib), and for the network-map directory the reference serves over the wire
+(node/.../network/NetworkMapService.kt:37-60) re-based — first stage — on a
+shared JSON file nodes register into (SURVEY.md §7 stage 5: "static
+file/directory service first, dynamic later").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..crypto.composite import CompositeKey
+from ..crypto.party import Party
+from .messaging.tcp import TcpAddress
+from .services.api import NodeInfo, ServiceInfo, ServiceType
+
+
+@dataclass(frozen=True)
+class BatchConfig:
+    """The max-wait micro-batch policy protecting notarisation p99
+    (SURVEY.md §7 stage 6: flush at N sigs or T ms, whichever first)."""
+
+    max_sigs: int = 4096
+    max_wait_ms: float = 2.0
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    name: str
+    base_dir: Path
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral (the netmap records the real port)
+    notary: str = "none"  # none | simple | validating
+    network_map: Path | None = None  # shared netmap file
+    verifier: str = "cpu"  # cpu | jax | jax-shadow
+    batch: BatchConfig = field(default_factory=BatchConfig)
+
+    @staticmethod
+    def load(path: str | os.PathLike) -> "NodeConfig":
+        """Parse a TOML config file; relative paths resolve against its dir."""
+        path = Path(path)
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return NodeConfig.from_dict(raw, default_dir=path.parent)
+
+    @staticmethod
+    def from_dict(raw: dict, default_dir: Path | None = None) -> "NodeConfig":
+        base = Path(raw.get("base_dir", default_dir or "."))
+        known = {"name", "base_dir", "host", "port", "notary", "network_map",
+                 "verifier", "batch"}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        notary = raw.get("notary", "none")
+        if notary not in ("none", "simple", "validating"):
+            raise ValueError(f"notary must be none|simple|validating, got {notary!r}")
+        nm = raw.get("network_map")
+        batch = raw.get("batch", {})
+        return NodeConfig(
+            name=raw["name"],
+            base_dir=base,
+            host=raw.get("host", "127.0.0.1"),
+            port=int(raw.get("port", 0)),
+            notary=notary,
+            network_map=(base / nm if nm and not os.path.isabs(nm) else
+                         Path(nm) if nm else None),
+            verifier=raw.get("verifier", "cpu"),
+            batch=BatchConfig(
+                max_sigs=int(batch.get("max_sigs", 4096)),
+                max_wait_ms=float(batch.get("max_wait_ms", 2.0)),
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# File-based network map
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class NetMapEntry:
+    name: str
+    host: str
+    port: int
+    owning_key_b58: str  # CompositeKey.to_base58_string() (whole tree)
+    services: tuple[str, ...] = ()
+
+    def party(self) -> Party:
+        return Party(self.name, CompositeKey.parse_from_base58(self.owning_key_b58))
+
+    def node_info(self) -> NodeInfo:
+        return NodeInfo(
+            address=TcpAddress(self.host, self.port),
+            legal_identity=self.party(),
+            advertised_services=tuple(
+                ServiceInfo(ServiceType(s)) for s in self.services),
+        )
+
+
+def _encode_owning_key(key: CompositeKey) -> str:
+    return key.to_base58_string()
+
+
+def netmap_register(path: str | os.PathLike, name: str, host: str, port: int,
+                    owning_key: CompositeKey,
+                    services: tuple[str, ...] = ()) -> None:
+    """Add/replace this node's entry (atomic file replace — last writer wins,
+    same-name entries collapse)."""
+    entries = netmap_load(path)
+    entries = [e for e in entries if e.name != name]
+    entries.append(NetMapEntry(name, host, port,
+                               _encode_owning_key(owning_key), tuple(services)))
+    payload = json.dumps([e.__dict__ | {"services": list(e.services)}
+                          for e in sorted(entries, key=lambda e: e.name)],
+                         indent=1)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def netmap_load(path: str | os.PathLike) -> list[NetMapEntry]:
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return []
+    return [NetMapEntry(e["name"], e["host"], e["port"], e["owning_key_b58"],
+                        tuple(e.get("services", ()))) for e in raw]

@@ -1,0 +1,433 @@
+"""TCP transport: durable per-peer outboxes, at-least-once delivery, dedupe.
+
+Capability match for the reference's Artemis tier (reference:
+node/src/main/kotlin/net/corda/node/services/messaging/ArtemisMessagingServer.kt:
+105-140,252-266 — durable per-peer queues + store-and-forward bridges — and
+NodeMessagingClient.kt:102-113 — persistent UUID dedupe), without the broker:
+each node listens on a plain TCP socket and drives its own outbox bridges.
+
+Delivery contract:
+  * send() appends to a durable outbox (sqlite when a NodeDatabase is given)
+    and returns — the peer being down never blocks or drops;
+  * a background bridge per peer connects, replays the outbox in order, and
+    deletes entries only when the peer ACKs — at-least-once;
+  * the receiver ACKs only after the message has been *processed* by the
+    node's handlers (mirroring the reference's ack-after-DB-commit,
+    NodeMessagingClient.kt:136-150), so a crash between receive and process
+    redelivers;
+  * processed unique ids are recorded durably; redeliveries are ACKed but not
+    re-dispatched (dedupe).
+
+Threading: socket I/O runs on daemon threads; handler dispatch happens ONLY
+inside pump()/run_forever() on the caller's thread — the single-threaded SMM
+contract is preserved (reference rationale: Node.kt:70-107).
+
+Wire format: 4-byte big-endian length + canonical-codec frame,
+  ("msg", topic, session_id, unique_id, sender_host, sender_port, data)
+  ("ack", unique_id)
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ...serialization.codec import DeserializationError, deserialize, register, serialize
+from .api import (
+    DEFAULT_SESSION_ID,
+    Message,
+    MessageHandlerRegistration,
+    MessagingService,
+    TopicSession,
+    fresh_message_id,
+)
+
+
+@register
+@dataclass(frozen=True, order=True)
+class TcpAddress:
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class _Handler(MessageHandlerRegistration):
+    topic: str
+    session_id: int
+    callback: Callable[[Message], None]
+
+
+class _Outbox:
+    """Durable (sqlite) or in-memory per-peer FIFO of unacked frames."""
+
+    def __init__(self, db=None):
+        self._db = db
+        self._mem: list[tuple[int, str, bytes, bytes]] = []
+        self._mem_seq = 0
+        self._lock = threading.Lock()
+
+    def append(self, peer: str, unique_id: bytes, frame: bytes) -> None:
+        if self._db is not None:
+            with self._lock:
+                self._db.conn.execute(
+                    "INSERT INTO outbox (peer, unique_id, blob) VALUES (?, ?, ?)",
+                    (peer, unique_id, frame))
+                self._db.conn.commit()
+        else:
+            with self._lock:
+                self._mem_seq += 1
+                self._mem.append((self._mem_seq, peer, unique_id, frame))
+
+    def pending(self, peer: str) -> list[tuple[int, bytes, bytes]]:
+        """[(seq, unique_id, frame)] in order for one peer."""
+        if self._db is not None:
+            with self._lock:
+                rows = self._db.conn.execute(
+                    "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
+                    "ORDER BY seq", (peer,)).fetchall()
+            return [(s, bytes(u), bytes(b)) for s, u, b in rows]
+        with self._lock:
+            return [(s, u, f) for s, p, u, f in self._mem if p == peer]
+
+    def peers(self) -> set[str]:
+        if self._db is not None:
+            with self._lock:
+                rows = self._db.conn.execute(
+                    "SELECT DISTINCT peer FROM outbox").fetchall()
+            return {r[0] for r in rows}
+        with self._lock:
+            return {p for _, p, _, _ in self._mem}
+
+    def ack(self, unique_id: bytes) -> None:
+        if self._db is not None:
+            with self._lock:
+                self._db.conn.execute(
+                    "DELETE FROM outbox WHERE unique_id = ?", (unique_id,))
+                self._db.conn.commit()
+        else:
+            with self._lock:
+                self._mem = [e for e in self._mem if e[2] != unique_id]
+
+
+class _Dedupe:
+    """Durable (sqlite) or in-memory set of processed message ids."""
+
+    def __init__(self, db=None):
+        self._db = db
+        self._mem: set[bytes] = set()
+        self._lock = threading.Lock()
+
+    def seen(self, unique_id: bytes) -> bool:
+        if self._db is not None:
+            with self._lock:
+                row = self._db.conn.execute(
+                    "SELECT 1 FROM dedupe WHERE message_id = ?",
+                    (unique_id,)).fetchone()
+            return row is not None
+        with self._lock:
+            return unique_id in self._mem
+
+    def record(self, unique_id: bytes) -> None:
+        if self._db is not None:
+            with self._lock:
+                self._db.conn.execute(
+                    "INSERT OR IGNORE INTO dedupe (message_id) VALUES (?)",
+                    (unique_id,))
+                self._db.conn.commit()
+        else:
+            with self._lock:
+                self._mem.add(unique_id)
+
+
+def _send_frame(sock: socket.socket, frame: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(frame)) + frame)
+
+
+def _recv_frame(sock: socket.socket) -> bytes | None:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack(">I", header)
+    if n > 64 * 1024 * 1024:
+        raise DeserializationError(f"frame too large: {n}")
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpMessaging(MessagingService):
+    """One node's TCP endpoint. Call start() to listen, pump() to dispatch."""
+
+    RETRY_BACKOFF = (0.05, 0.1, 0.2, 0.5, 1.0)  # then every 1s
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, db=None):
+        self._listen_host, self._listen_port = host, port
+        self._db = db
+        self._outbox = _Outbox(db)
+        self._dedupe = _Dedupe(db)
+        self._handlers: list[_Handler] = []
+        # (reply_socket | None, Message) pairs awaiting dispatch on pump().
+        self._inbound: "queue.Queue[tuple[Any, Message]]" = queue.Queue()
+        self._pending_no_handler: list[tuple[Any, Message]] = []
+        self._server: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._bridges: dict[str, threading.Thread] = {}
+        self._bridge_wakeups: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._address: TcpAddress | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "TcpMessaging":
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self._listen_host, self._listen_port))
+        self._server.listen(64)
+        host, port = self._server.getsockname()
+        self._address = TcpAddress(host, port)
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"tcp-accept-{port}")
+        t.start()
+        self._threads.append(t)
+        # Resume bridges for peers with queued outbox entries (store-and-
+        # forward across restarts, ArtemisMessagingServer.kt:252-266).
+        for peer in self._outbox.peers():
+            self._ensure_bridge(peer)
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._server is not None:
+            # shutdown() wakes a thread blocked in accept(); close() alone
+            # leaves the fd (and the port) held by that syscall on Linux.
+            try:
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for ev in self._bridge_wakeups.values():
+            ev.set()
+        # Give the accept thread a beat to leave accept() so the port frees.
+        for t in self._threads[:1]:
+            t.join(timeout=1.0)
+
+    @property
+    def my_address(self) -> TcpAddress:
+        if self._address is None:
+            raise RuntimeError("start() first")
+        return self._address
+
+    # -- sending -----------------------------------------------------------
+
+    def send(self, topic_session: TopicSession, data: bytes, to: Any) -> None:
+        if not isinstance(to, TcpAddress):
+            raise TypeError(f"TcpMessaging can only send to TcpAddress, got {to!r}")
+        unique_id = fresh_message_id()
+        frame = serialize((
+            "msg", topic_session.topic, topic_session.session_id, unique_id,
+            self.my_address.host, self.my_address.port, data,
+        )).bytes
+        peer = str(to)
+        self._outbox.append(peer, unique_id, frame)
+        self._ensure_bridge(peer)
+
+    def _ensure_bridge(self, peer: str) -> None:
+        with self._lock:
+            ev = self._bridge_wakeups.setdefault(peer, threading.Event())
+            ev.set()
+            t = self._bridges.get(peer)
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._bridge_loop, args=(peer, ev),
+                                     daemon=True, name=f"bridge-{peer}")
+                self._bridges[peer] = t
+                t.start()
+
+    def _bridge_loop(self, peer: str, wakeup: threading.Event) -> None:
+        """Store-and-forward bridge: replay the peer's outbox until empty,
+        deleting on ACK; reconnect with backoff forever while running."""
+        host, port_s = peer.rsplit(":", 1)
+        attempt = 0
+        while self._running:
+            pending = self._outbox.pending(peer)
+            if not pending:
+                wakeup.clear()
+                wakeup.wait(timeout=1.0)
+                if not self._running:
+                    return
+                continue
+            try:
+                with socket.create_connection((host, int(port_s)),
+                                              timeout=5.0) as sock:
+                    attempt = 0
+                    self._replay_outbox(peer, sock)
+            except OSError:
+                backoff = self.RETRY_BACKOFF[
+                    min(attempt, len(self.RETRY_BACKOFF) - 1)]
+                attempt += 1
+                wakeup.clear()
+                wakeup.wait(timeout=backoff)
+
+    def _replay_outbox(self, peer: str, sock: socket.socket) -> None:
+        """Stream outbox frames and consume ACKs concurrently (no head-of-line
+        blocking: frames enqueued while earlier ones await ACK still go out).
+        Returns when the outbox is empty; raises OSError to trigger
+        reconnect + redeliver when the peer stalls or drops."""
+        sock.settimeout(0.2)
+        sent: set[bytes] = set()
+        idle_polls = 0
+        while self._running:
+            pending = self._outbox.pending(peer)
+            if not pending:
+                return
+            for _seq, unique_id, frame in pending:
+                if unique_id not in sent:
+                    _send_frame(sock, frame)
+                    sent.add(unique_id)
+            try:
+                frame = _recv_frame(sock)
+                if frame is None:
+                    raise OSError("peer closed during ack wait")
+                decoded = deserialize(frame)
+                if decoded[0] == "ack":
+                    self._outbox.ack(decoded[1])
+                    sent.discard(decoded[1])
+                idle_polls = 0
+            except socket.timeout:
+                idle_polls += 1
+                if idle_polls > 50:  # ~10s with frames outstanding, no ACK
+                    raise OSError("peer not acking")
+
+    # -- receiving ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        self._server.settimeout(0.5)  # poll _running; also frees the port fast
+        while self._running:
+            try:
+                conn, _addr = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._reader_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                try:
+                    decoded = deserialize(frame)
+                    kind = decoded[0]
+                    if kind != "msg":
+                        continue
+                    _, topic, session_id, unique_id, shost, sport, data = decoded
+                except (DeserializationError, ValueError, IndexError):
+                    continue  # junk from the wire: drop, never crash
+                message = Message(
+                    topic_session=TopicSession(topic, session_id),
+                    data=data,
+                    unique_id=unique_id,
+                    sender=TcpAddress(shost, sport),
+                )
+                self._inbound.put((conn, message))
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- dispatch (caller thread) ------------------------------------------
+
+    def add_message_handler(
+        self,
+        topic: str,
+        session_id: int = DEFAULT_SESSION_ID,
+        callback: Callable[[Message], None] = None,
+    ) -> MessageHandlerRegistration:
+        assert callback is not None
+        handler = _Handler(topic, session_id, callback)
+        self._handlers.append(handler)
+        # Requeue messages that arrived before this handler registered.
+        pending, self._pending_no_handler = self._pending_no_handler, []
+        for item in pending:
+            self._inbound.put(item)
+        return handler
+
+    def remove_message_handler(self, registration: MessageHandlerRegistration) -> None:
+        self._handlers.remove(registration)
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """Dispatch queued inbound messages on THIS thread; ACK after
+        processing. Returns number dispatched. timeout>0 blocks for the
+        first message."""
+        n = 0
+        while True:
+            first_blocking = n == 0 and timeout > 0
+            try:
+                conn, message = self._inbound.get(
+                    block=first_blocking,
+                    timeout=timeout if first_blocking else None)
+            except queue.Empty:
+                return n
+            if self._dispatch(conn, message):
+                n += 1
+
+    def _dispatch(self, conn, message: Message) -> bool:
+        if self._dedupe.seen(message.unique_id):
+            self._ack(conn, message.unique_id)  # redelivery: ack, don't re-run
+            return False
+        handlers = [h for h in self._handlers
+                    if h.topic == message.topic_session.topic
+                    and h.session_id == message.topic_session.session_id]
+        if not handlers:
+            # Park until a handler registers — but ACK now, mirroring the
+            # in-memory tier's semantics (parked messages live in RAM there
+            # too) and the reference's consume-then-discard of unroutable
+            # session messages (StateMachineManager.kt "unknown session").
+            # Without the ACK a dead session's trailing SessionEnd would
+            # wedge the sender's bridge behind an ACK that never comes.
+            self._pending_no_handler.append((conn, message))
+            self._ack(conn, message.unique_id)
+            return False
+        for h in handlers:
+            h.callback(message)
+        # Processed: record id durably, THEN ack (crash before this point
+        # means the sender redelivers; crash after means dedupe swallows it).
+        self._dedupe.record(message.unique_id)
+        self._ack(conn, message.unique_id)
+        return True
+
+    def _ack(self, conn, unique_id: bytes) -> None:
+        if conn is None:
+            return
+        try:
+            _send_frame(conn, serialize(("ack", unique_id)).bytes)
+        except OSError:
+            pass  # sender gone; it will reconnect and redeliver

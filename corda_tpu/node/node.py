@@ -1,0 +1,239 @@
+"""The production node: config-driven assembly over durable storage + TCP.
+
+Capability match for the reference's node assembly (reference:
+node/src/main/kotlin/net/corda/node/internal/AbstractNode.kt:179-258 —
+storage -> messaging -> vault/identity/keys -> SMM -> notary, one start()
+sequence) and the CLI entry point (node/.../Main.kt:34-114).  Differences are
+TPU-first by design: the verifier provider (cpu | jax) is part of the config,
+and the run loop enforces the max-wait verify micro-batch policy (flush at N
+sigs or T ms, whichever first) that keeps notarisation p99 bounded while
+batches stay wide (SURVEY.md §7 stage 6).
+
+Crash contract: every durable store commits before the call returns
+(NodeDatabase), so `kill -9` at any point leaves a database a fresh Node over
+the same base_dir resumes from — including mid-flow checkpoints
+(restoreFibersFromCheckpoints capability, StateMachineManager.kt:190-226).
+
+Run it:  python -m corda_tpu.node.node <config.toml>
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+from ..crypto.provider import CpuVerifier, JaxVerifier
+from ..flows.data_vending import install_data_vending
+from ..utils.clock import Clock
+from .config import NetMapEntry, NodeConfig, netmap_load, netmap_register
+from .messaging.tcp import TcpMessaging
+from .services.api import (
+    NodeInfo,
+    ServiceHub,
+    ServiceInfo,
+    SIMPLE_NOTARY,
+    StorageService,
+    VALIDATING_NOTARY,
+)
+from .services.inmemory import (
+    InMemoryIdentityService,
+    InMemoryNetworkMapCache,
+    NodeVaultService,
+    SimpleKeyManagementService,
+)
+from .services.notary import SimpleNotaryService, ValidatingNotaryService
+from .services.persistence import (
+    DBAttachmentStorage,
+    DBCheckpointStorage,
+    DBTransactionStorage,
+    NodeDatabase,
+    PersistentUniquenessProvider,
+)
+from .statemachine import FlowHandle, StateMachineManager
+
+
+def _make_verifier(kind: str):
+    if kind == "jax":
+        return JaxVerifier()
+    if kind == "jax-shadow":
+        return JaxVerifier(shadow_rate=0.05)
+    return CpuVerifier()
+
+
+class Node:
+    """One process-owning node instance over a base_dir."""
+
+    def __init__(self, config: NodeConfig):
+        self.config = config
+        config.base_dir.mkdir(parents=True, exist_ok=True)
+        self.db = NodeDatabase(config.base_dir / "node.db")
+        self.key = self.db.load_or_create_identity(config.name)
+        from ..crypto.party import Party
+
+        self.identity = Party.of(config.name, self.key.public)
+
+        # -- messaging (starts listening immediately; handlers attach below) --
+        # A restarted node must come back on its previous port so peers'
+        # queued outbox bridges (keyed by host:port) reconnect — the stable-
+        # address property Artemis queues give the reference. An ephemeral
+        # first start records the allocated port.
+        port = config.port
+        if port == 0:
+            stored = self.db.get_setting("listen_port")
+            if stored is not None:
+                port = int(stored)
+        try:
+            self.messaging = TcpMessaging(config.host, port, db=self.db)
+            self.messaging.start()
+        except OSError:
+            # Stored port taken (another process got it) — fall back to
+            # ephemeral; netmap re-registration updates peers going forward.
+            self.messaging = TcpMessaging(config.host, 0, db=self.db)
+            self.messaging.start()
+        self.db.set_setting("listen_port", str(self.messaging.my_address.port))
+
+        # -- advertised services ------------------------------------------
+        services = ()
+        if config.notary == "simple":
+            services = (ServiceInfo(SIMPLE_NOTARY),)
+        elif config.notary == "validating":
+            services = (ServiceInfo(VALIDATING_NOTARY),)
+        self.info = NodeInfo(
+            address=self.messaging.my_address,
+            legal_identity=self.identity,
+            advertised_services=services,
+        )
+
+        # -- service hub ---------------------------------------------------
+        self.identity_service = InMemoryIdentityService()
+        self.network_map_cache = InMemoryNetworkMapCache()
+        key_service = SimpleKeyManagementService([self.key])
+        self.services = ServiceHub(
+            identity_service=self.identity_service,
+            key_management_service=key_service,
+            storage_service=StorageService(
+                validated_transactions=DBTransactionStorage(self.db),
+                attachments=DBAttachmentStorage(self.db),
+            ),
+            vault_service=NodeVaultService(
+                lambda: set(key_service.keys.keys())),
+            network_map_cache=self.network_map_cache,
+            clock=Clock(),
+            my_info=self.info,
+        )
+
+        # -- state machine manager ----------------------------------------
+        self.smm = StateMachineManager(
+            service_hub=self.services,
+            messaging=self.messaging,
+            checkpoint_storage=DBCheckpointStorage(self.db),
+            verifier=_make_verifier(config.verifier),
+            our_identity=self.identity,
+            defer_verify=True,  # the run loop owns the flush policy
+        )
+
+        # -- notary --------------------------------------------------------
+        self.uniqueness_provider = None
+        self.notary_service = None
+        if config.notary != "none":
+            self.uniqueness_provider = PersistentUniquenessProvider(self.db)
+            cls = (ValidatingNotaryService if config.notary == "validating"
+                   else SimpleNotaryService)
+            self.notary_service = cls(
+                self.smm, self.services, self.identity, self.key,
+                self.uniqueness_provider)
+
+        install_data_vending(self.smm)
+        self._started = False
+
+    # -- network map -------------------------------------------------------
+
+    def register_and_refresh_netmap(self) -> None:
+        """Write our entry to the shared netmap file, then (re)load peers
+        into the cache and identity service."""
+        path = self.config.network_map
+        if path is None:
+            return
+        netmap_register(
+            path, self.config.name, self.messaging.my_address.host,
+            self.messaging.my_address.port, self.identity.owning_key,
+            tuple(str(s.type) for s in self.info.advertised_services))
+        self.refresh_netmap()
+
+    def refresh_netmap(self) -> None:
+        path = self.config.network_map
+        if path is None:
+            return
+        for entry in netmap_load(path):
+            info = entry.node_info()
+            self.identity_service.register_identity(info.legal_identity)
+            self.network_map_cache.add_node(info)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Node":
+        """Register in the map, restore checkpoints, resume flows."""
+        self.register_and_refresh_netmap()
+        self.smm.start()
+        self._started = True
+        return self
+
+    def start_flow(self, logic) -> FlowHandle:
+        return self.smm.add(logic)
+
+    def run_once(self, timeout: float = 0.05) -> int:
+        """One scheduling round: dispatch inbound messages, then apply the
+        max-wait micro-batch policy. Returns messages dispatched."""
+        batch = self.config.batch
+        wait = timeout
+        if self.smm.verify_pending_sigs:
+            # Shrink the wait so the flush deadline is honoured.
+            deadline = (self.smm.verify_waiting_since
+                        + batch.max_wait_ms / 1e3)
+            wait = max(0.0, min(timeout, deadline - time.monotonic()))
+        n = self.messaging.pump(timeout=wait)
+        pending = self.smm.verify_pending_sigs
+        if pending and (
+            pending >= batch.max_sigs
+            or time.monotonic() - self.smm.verify_waiting_since
+            >= batch.max_wait_ms / 1e3
+        ):
+            self.smm.flush_pending_verifies()
+        return n
+
+    def run_forever(self) -> None:
+        while True:
+            self.run_once(timeout=0.05)
+            self.refresh_netmap_maybe()
+
+    _netmap_refreshed_at = 0.0
+
+    def refresh_netmap_maybe(self, every: float = 1.0) -> None:
+        now = time.monotonic()
+        if now - self._netmap_refreshed_at >= every:
+            self._netmap_refreshed_at = now
+            self.refresh_netmap()
+
+    def stop(self) -> None:
+        self.messaging.stop()
+        self.db.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m corda_tpu.node.node <config.toml>",
+              file=sys.stderr)
+        return 2
+    config = NodeConfig.load(argv[0])
+    node = Node(config).start()
+    print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
+    try:
+        node.run_forever()
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

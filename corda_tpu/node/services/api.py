@@ -19,6 +19,7 @@ from ...crypto.hashes import SecureHash
 from ...crypto.keys import DigitalSignature, KeyPair, PublicKey
 from ...crypto.party import Party
 from ...serialization.codec import register
+from ...utils.excheckpoint import register_flow_exception
 
 
 # ---------------------------------------------------------------------------
@@ -234,10 +235,20 @@ class UniquenessConflict:
     state_history: dict  # StateRef -> ConsumingTx
 
 
+@register_flow_exception
 class UniquenessException(Exception):
+    """Keeps its structured conflict through checkpoint replay."""
+
     def __init__(self, error: UniquenessConflict):
         super().__init__(f"Uniqueness conflict: {error}")
         self.error = error
+
+    def __checkpoint_payload__(self):
+        return self.error
+
+    @classmethod
+    def __from_checkpoint__(cls, message, payload):
+        return cls(payload)
 
 
 class UniquenessProvider:

@@ -53,6 +53,7 @@ from ..flows.api import (
 )
 from ..serialization.codec import deserialize, register, serialize
 from ..serialization.tokens import TokenContext
+from ..utils.excheckpoint import record_exception, rebuild_exception
 from .messaging.api import DEFAULT_SESSION_ID, Message, MessagingService, TopicSession
 
 logger = logging.getLogger(__name__)
@@ -385,11 +386,16 @@ class FlowStateMachine:
         return self._replay_cursor < len(self.resolved)
 
     def _record(self, kind: str, value=None, err: BaseException | None = None):
+        """Append a suspension result; returns the entry so callers feed the
+        generator the SAME tuple live as replay will (payloads included —
+        typed exceptions must rebuild identically on both paths)."""
         if kind == "v":
-            self.resolved.append(("v", value))
+            entry = ("v", value)
         else:
-            self.resolved.append(("e", type(err).__name__, str(err)))
+            entry = record_exception(err)
+        self.resolved.append(entry)
         self._replay_cursor = len(self.resolved)
+        return entry
 
     def _next_feed(self):
         """What to send into the generator for the current step."""
@@ -492,16 +498,16 @@ class FlowStateMachine:
             err = FlowSessionException(
                 f"Counterparty flow on {request.party} has ended before sending data"
             )
-            self._record("e", err=err)
+            entry = self._record("e", err=err)
             self.manager._checkpoint(self)
-            return ("e", type(err).__name__, str(err))
+            return entry
         if not isinstance(payload, request.expected_type):
             err = FlowSessionException(
                 f"Expected {request.expected_type.__name__}, got {type(payload).__name__}"
             )
-            self._record("e", err=err)
+            entry = self._record("e", err=err)
             self.manager._checkpoint(self)
-            return ("e", type(err).__name__, str(err))
+            return entry
         value = UntrustworthyData(payload)
         self._record("v", value)  # wrapped, so replay feeds the same shape
         self.manager._checkpoint(self)
@@ -528,11 +534,9 @@ class FlowStateMachine:
         assert self.state == _WAIT_VERIFY
         self.state = _RUNNABLE
         if ok:
-            self._record("v", None)
-            self.pending_value = ("v", None)
+            self.pending_value = self._record("v", None)
         else:
-            self._record("e", err=error)
-            self.pending_value = ("e", type(error).__name__, str(error))
+            self.pending_value = self._record("e", err=error)
         self.manager._checkpoint(self)
         self.manager._mark_runnable(self)
 
@@ -602,14 +606,12 @@ _SESSION_ENDED = _SessionEndedMarker()
 
 
 def _rebuild_exception(entry) -> BaseException:
-    _, type_name, message = entry
-    if type_name in ("SignatureError", "SignaturesMissingException"):
-        return SignatureError(message)
-    if type_name == "FlowSessionException":
-        return FlowSessionException(message)
-    if type_name == "UniquenessException":
-        # Re-raised without the structured conflict (kept in the message).
-        return FlowException(message)
+    """Typed rebuild via the excheckpoint whitelist; unregistered types
+    degrade to a generic FlowException with the original name in the text."""
+    exc = rebuild_exception(entry)
+    if exc is not None:
+        return exc
+    _, type_name, message, *_rest = entry
     return FlowException(f"{type_name}: {message}")
 
 
@@ -653,6 +655,8 @@ class StateMachineManager:
         self._flow_factories: dict[str, Callable[[Party], FlowLogic]] = {}
         self._runnable: list[FlowStateMachine] = []
         self._verify_queue: list[tuple[FlowStateMachine, VerifyTxRequest]] = []
+        self._verify_sig_count = 0
+        self._verify_waiting_since = 0.0
         self._pumping = False
         self.changes: list[tuple[str, bytes]] = []  # (event, run_id) feed
         # Metrics (reference: StateMachineManager.kt:105-113)
@@ -773,11 +777,30 @@ class StateMachineManager:
     # -- the verification pump (TPU seam) ---------------------------------
 
     def _enqueue_verify(self, fsm: FlowStateMachine, request: VerifyTxRequest) -> None:
+        if not self._verify_queue:
+            import time as _time
+
+            self._verify_waiting_since = _time.monotonic()
         self._verify_queue.append((fsm, request))
+        # Count at least 1 per request: a zero-signature request (can't arise
+        # from SignedTransaction today, which demands >=1 sig, but belt-and-
+        # braces) must still trip the flush gate or its flow parks forever.
+        self._verify_sig_count += max(len(request.stx.sigs), 1)
+
+    @property
+    def verify_pending_sigs(self) -> int:
+        """Signatures waiting in the micro-batch (max-wait scheduler input)."""
+        return self._verify_sig_count
+
+    @property
+    def verify_waiting_since(self) -> float:
+        """monotonic() when the current micro-batch started accumulating."""
+        return self._verify_waiting_since
 
     def _flush_verify_batch(self) -> None:
         """One batched kernel call covering every parked VerifyTxRequest."""
         batch, self._verify_queue = self._verify_queue, []
+        self._verify_sig_count = 0
         jobs: list[VerifyJob] = []
         spans: list[tuple[FlowStateMachine, VerifyTxRequest, int, int]] = []
         for fsm, request in batch:

@@ -7,11 +7,14 @@ with a registration whitelist so deserialization can never instantiate
 unexpected classes (the reference gets this from registered Kryo serializers
 and its attack-surface notes).
 
-Unlike Kryo this format is *canonical by construction* — a value has exactly
-one encoding — because transaction ids are Merkle roots over serialized
-components (reference: core/.../transactions/WireTransaction.kt:45-52,
-MerkleTransaction.kt:26-38) and must be stable across processes, hosts and
-framework versions. Design:
+Unlike Kryo this format is *canonical* in both directions: a value has exactly
+one encoding (sorted dict/set entries, minimal varints), and the decoder
+*rejects* any non-canonical byte string (non-minimal varints, unsorted or
+duplicate entries) — so distinct blobs never decode to equal values and every
+stored blob is tamper-evident by re-hash. This matters because transaction ids
+are Merkle roots over serialized components (reference:
+core/.../transactions/WireTransaction.kt:45-52, MerkleTransaction.kt:26-38)
+and must be stable across processes, hosts and framework versions. Design:
 
   tag byte, then payload:
     0x00 None        0x01 False        0x02 True
@@ -115,6 +118,10 @@ def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not b & 0x80:
+            # Canonicality: a multi-byte varint whose final group is zero has
+            # a shorter encoding — reject so every int has exactly one form.
+            if b == 0 and shift > 0:
+                raise DeserializationError("non-minimal varint")
             return result, pos
         shift += 7
 
@@ -250,16 +257,33 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
     if tag == _TAG_DICT:
         n, pos = _read_varint(data, pos)
         d = {}
+        prev_kenc: bytes | None = None
         for _ in range(n):
+            kstart = pos
             k, pos = _decode(data, pos)
+            kenc = data[kstart:pos]
             v, pos = _decode(data, pos)
+            # Canonicality: KEY encodings must arrive strictly increasing —
+            # strictness on the key alone also rejects duplicate keys (a
+            # duplicate with a larger value encoding would otherwise pass a
+            # (key, value)-pair comparison), so distinct byte strings can
+            # never decode to equal dicts.
+            if prev_kenc is not None and kenc <= prev_kenc:
+                raise DeserializationError("non-canonical dict entry order")
+            prev_kenc = kenc
             d[k] = v
         return d, pos
     if tag == _TAG_FROZENSET:
         n, pos = _read_varint(data, pos)
         items = []
+        prev_enc: bytes | None = None
         for _ in range(n):
+            start = pos
             item, pos = _decode(data, pos)
+            enc = data[start:pos]
+            if prev_enc is not None and enc <= prev_enc:
+                raise DeserializationError("non-canonical frozenset order")
+            prev_enc = enc
             items.append(item)
         return frozenset(items), pos
     if tag == _TAG_OBJECT:

@@ -27,8 +27,16 @@ from ..serialization.codec import SerializedBytes, register
 from .wire import WireTransaction
 
 
+from ..utils.excheckpoint import register_flow_exception
+
+
+@register_flow_exception
 class SignaturesMissingException(SignatureError):
-    """Required signatures absent (SignedTransaction.kt:41-46)."""
+    """Required signatures absent (SignedTransaction.kt:41-46).
+
+    Survives checkpoint replay with its structure intact so restored flows
+    can branch on isinstance / .missing exactly as live ones do.
+    """
 
     def __init__(self, missing: set[CompositeKey], descriptions: list[str], id: SecureHash):
         super().__init__(
@@ -38,6 +46,14 @@ class SignaturesMissingException(SignatureError):
         self.missing = missing
         self.descriptions = descriptions
         self.id = id
+
+    def __checkpoint_payload__(self):
+        return (frozenset(self.missing), tuple(self.descriptions), self.id)
+
+    @classmethod
+    def __from_checkpoint__(cls, message, payload):
+        missing, descriptions, id = payload
+        return cls(set(missing), list(descriptions), id)
 
 
 @register

@@ -66,7 +66,18 @@ class WireTransaction:
     @property
     def all_leaves_hashes(self) -> list[SecureHash]:
         """Per-component canonical-serialization hashes, in the fixed
-        component-group order (MerkleTransaction.kt:26-31)."""
+        component-group order (MerkleTransaction.kt:26-31).
+
+        KNOWN MALLEABILITY (inherited, reference parity): the id covers only
+        inputs/outputs/attachments/commands — exactly the reference snapshot's
+        calculateLeavesHashes — so notary, signers, type and timestamp can be
+        re-encoded by a relayer without changing the id or invalidating
+        signatures. Later upstream versions add those fields as extra leaves;
+        here we keep bit-parity with the snapshot. The id cross-check in
+        SignedTransaction.tx catches component tampering only; altered
+        notary/signers/type/timestamp must be caught by the verification
+        rules that read them (timestamp window, notary match, must_sign
+        fulfilment), which run on the payload the verifier received."""
         cached = getattr(self, "_leaves", None)
         if cached is None:
             cached = [

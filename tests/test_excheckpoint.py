@@ -1,0 +1,138 @@
+"""Typed exceptions survive checkpoint replay with structure intact.
+
+The reference keeps exception fidelity by Kryo-serializing live fibers
+(reference: node/.../statemachine/FlowStateMachineImpl.kt:238-261); here the
+whitelisted excheckpoint registry carries types + structured payloads through
+the replay-checkpoint codec instead.
+"""
+
+import pytest
+
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.keys import KeyPair, SignatureError
+from corda_tpu.crypto.party import Party
+from corda_tpu.flows.api import FlowException, FlowSessionException
+from corda_tpu.flows.notary import (
+    NotaryConflict,
+    NotaryException,
+    NotarySignaturesMissing,
+    NotaryTimestampInvalid,
+)
+from corda_tpu.node.services.api import (
+    ConsumingTx,
+    UniquenessConflict,
+    UniquenessException,
+)
+from corda_tpu.node.statemachine import _rebuild_exception
+from corda_tpu.serialization.codec import deserialize, serialize
+from corda_tpu.utils.excheckpoint import record_exception, rebuild_exception
+
+
+def _roundtrip(exc):
+    """record -> codec serialize -> deserialize -> rebuild, as replay does."""
+    entry = record_exception(exc)
+    entry2 = deserialize(serialize(entry).bytes)
+    return _rebuild_exception(tuple(entry2))
+
+
+def test_signature_error_keeps_type():
+    out = _roundtrip(SignatureError("Signature did not match"))
+    assert type(out) is SignatureError
+    assert "did not match" in str(out)
+
+
+def test_signatures_missing_keeps_structure():
+    from corda_tpu.transactions.signed import SignaturesMissingException
+
+    key = KeyPair.generate(b"\x07" * 32).public.composite
+    exc = SignaturesMissingException({key}, ["notary"], SecureHash.zero())
+    out = _roundtrip(exc)
+    assert isinstance(out, SignaturesMissingException)
+    assert isinstance(out, SignatureError)  # subtype relation preserved
+    assert out.missing == {key}
+    assert out.descriptions == ["notary"]
+    assert out.id == SecureHash.zero()
+
+
+def test_notary_exception_keeps_error_kind():
+    out = _roundtrip(NotaryException(NotaryTimestampInvalid()))
+    assert isinstance(out, NotaryException)
+    assert isinstance(out.error, NotaryTimestampInvalid)
+    # A flow branching on the error kind post-restore behaves as it did live.
+    missing = _roundtrip(NotaryException(NotarySignaturesMissing(frozenset())))
+    assert isinstance(missing.error, NotarySignaturesMissing)
+
+
+def test_uniqueness_exception_keeps_conflict_evidence():
+    party = Party("Bank A", KeyPair.generate(b"\x01" * 32).public.composite)
+    conflict = UniquenessConflict(
+        state_history={SecureHash.zero(): ConsumingTx(SecureHash.zero(), 0, party)}
+    )
+    out = _roundtrip(UniquenessException(conflict))
+    assert isinstance(out, UniquenessException)
+    assert out.error == conflict
+
+
+def test_flow_session_exception_type_preserved():
+    out = _roundtrip(FlowSessionException("peer rejected"))
+    assert type(out) is FlowSessionException
+
+
+def test_unregistered_type_degrades_to_flow_exception():
+    class WeirdError(Exception):
+        pass
+
+    out = _roundtrip(WeirdError("boom"))
+    assert type(out) is FlowException
+    assert "WeirdError" in str(out) and "boom" in str(out)
+
+
+def test_rebuild_exception_returns_none_for_unknown():
+    assert rebuild_exception(("e", "NoSuchType", "msg")) is None
+
+
+def test_live_verify_failure_keeps_type(net=None):
+    """The LIVE (non-replay) path must throw the same typed exception replay
+    rebuilds: a missing-signature failure from the batched verifier arrives
+    in the flow as SignaturesMissingException, not a generic FlowException."""
+    from corda_tpu.crypto.provider import CpuVerifier
+    from corda_tpu.flows.api import FlowLogic, register_flow
+    from corda_tpu.testing.mock_network import MockNetwork
+    from corda_tpu.testing.dummies import DummyContract
+    from corda_tpu.transactions.signed import SignaturesMissingException
+
+    net = MockNetwork(verifier=CpuVerifier())
+    try:
+        notary = net.create_notary_node("Notary")
+        alice = net.create_node("Alice")
+        bob = net.create_node("Bob")
+
+        builder = DummyContract.generate_initial(
+            alice.identity.ref(b"\x01"), 1, notary.identity)
+        builder.sign_with(alice.key)
+        issue = builder.to_signed_transaction()
+        alice.record_transaction(issue)
+        move = DummyContract.move(issue.tx.out_ref(0), bob.identity.owning_key)
+        move.sign_with(bob.key)  # WRONG signer: alice's signature is missing
+        stx = move.to_signed_transaction(check_sufficient_signatures=False)
+
+        caught = []
+
+        @register_flow
+        class CatchTyped(FlowLogic):
+            def __init__(self, stx):
+                self.stx = stx
+
+            def call(self):
+                try:
+                    yield self.verify_signatures_batched(self.stx)
+                except SignaturesMissingException as e:
+                    caught.append(("typed", sorted(map(repr, e.missing))))
+                except Exception as e:
+                    caught.append(("untyped", type(e).__name__))
+
+        alice.start_flow(CatchTyped(stx))
+        net.run_network()
+        assert caught and caught[0][0] == "typed", caught
+    finally:
+        net.stop_nodes()

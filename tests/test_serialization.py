@@ -105,3 +105,66 @@ class TestObjects:
         sig = kp.sign(b"msg")
         value = {"party": party, "sig": sig, "hash": SecureHash.sha256(b"x")}
         assert deserialize(serialize(value).bytes) == value
+
+
+# ---------------------------------------------------------------------------
+# Decode-side canonicality (the codec rejects non-canonical byte strings)
+# ---------------------------------------------------------------------------
+
+
+def test_decoder_rejects_non_minimal_varint():
+    import pytest
+    from corda_tpu.serialization.codec import DeserializationError, deserialize
+
+    # int 1 is tag 0x03 + zigzag(1)=2 -> varint [0x02]; [0x82, 0x00] encodes
+    # the same value non-minimally.
+    assert deserialize(bytes([0x03, 0x02])) == 1
+    with pytest.raises(DeserializationError):
+        deserialize(bytes([0x03, 0x82, 0x00]))
+
+
+def test_decoder_rejects_unsorted_and_duplicate_dict_entries():
+    import pytest
+    from corda_tpu.serialization.codec import (
+        DeserializationError, deserialize, serialize,
+    )
+
+    canonical = serialize({1: "a", 2: "b"}).bytes
+    assert deserialize(canonical) == {1: "a", 2: "b"}
+    # Swap the two entries: same decoded value, different bytes -> reject.
+    body = canonical[2:]
+    half = len(body) // 2
+    swapped = canonical[:2] + body[half:] + body[:half]
+    with pytest.raises(DeserializationError):
+        deserialize(swapped)
+    # Duplicate entry: entries compare equal -> reject (no silent collapse).
+    dup = canonical[:2] + body[:half] + body[:half]
+    with pytest.raises(DeserializationError):
+        deserialize(dup)
+
+
+def test_decoder_rejects_unsorted_frozenset():
+    import pytest
+    from corda_tpu.serialization.codec import (
+        DeserializationError, deserialize, serialize,
+    )
+
+    canonical = serialize(frozenset([1, 2])).bytes
+    assert deserialize(canonical) == frozenset([1, 2])
+    body = canonical[2:]
+    half = len(body) // 2
+    swapped = canonical[:2] + body[half:] + body[:half]
+    with pytest.raises(DeserializationError):
+        deserialize(swapped)
+
+
+def test_decoder_rejects_duplicate_key_with_differing_values():
+    # Duplicate KEYS with ascending value encodings would pass a naive
+    # (key, value)-pair ordering check; the decoder must compare keys alone.
+    import pytest
+    from corda_tpu.serialization.codec import DeserializationError, deserialize
+
+    # dict {1:'a', 1:'b'}: tag 07, count 2, then (int 1,'a'), (int 1,'b')
+    crafted = bytes.fromhex("070203020501610302050162")
+    with pytest.raises(DeserializationError):
+        deserialize(crafted)

@@ -1,0 +1,60 @@
+"""Golden vectors for the batched SHA-256 kernel vs hashlib.
+
+Reference semantics: SecureHash.sha256 content addressing (reference:
+core/src/main/kotlin/net/corda/core/crypto/SecureHash.kt:33) and the Merkle
+odd-node-duplicate rule (core/.../transactions/MerkleTransaction.kt:62-99).
+"""
+
+import hashlib
+import random
+
+import numpy as np
+
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.merkle import MerkleTree
+from corda_tpu.ops import sha256_jax as sj
+
+
+def test_fixed_length_padding_edges():
+    # Every padding regime: empty, <55, ==55 (one-block limit), 56-63
+    # (length field spills to a second block), exact multiples of 64.
+    rng = random.Random(7)
+    for length in (0, 1, 31, 54, 55, 56, 57, 63, 64, 65, 119, 120, 128, 200):
+        batch = np.array(
+            [[rng.randrange(256) for _ in range(length)] for _ in range(5)],
+            np.uint8).reshape(5, length)
+        got = sj.sha256_fixed(batch)
+        for i in range(5):
+            assert got[i].tobytes() == hashlib.sha256(batch[i].tobytes()).digest(), length
+
+
+def test_nist_vectors():
+    # FIPS 180-2 examples.
+    assert sj.sha256_many([b"abc"])[0] == bytes.fromhex(
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+    assert sj.sha256_many(
+        [b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq"])[0] == bytes.fromhex(
+        "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1")
+
+
+def test_ragged_batch_buckets():
+    rng = random.Random(11)
+    msgs = [bytes(rng.randrange(256) for _ in range(n))
+            for n in (0, 1, 3, 55, 56, 64, 57, 200, 1000, 64, 63, 119)]
+    got = sj.sha256_many(msgs)
+    assert [g for g in got] == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_merkle_root_matches_host_tree():
+    for n in (1, 2, 3, 4, 5, 7, 8, 13, 16, 33):
+        leaves = [SecureHash.sha256(bytes([i, n])) for i in range(n)]
+        want = MerkleTree.build(leaves).hash.bytes
+        got = sj.merkle_root_device([l.bytes for l in leaves])
+        assert got == want, n
+
+
+def test_pair_words_is_hash_concat():
+    a = SecureHash.sha256(b"left")
+    b = SecureHash.sha256(b"right")
+    got = sj.merkle_root_device([a.bytes, b.bytes])
+    assert got == a.hash_concat(b).bytes

@@ -1,0 +1,64 @@
+"""Multi-chip SPMD verify on the virtual 8-device CPU mesh.
+
+The mesh is the only difference from the single-chip path; accept/reject must
+stay bit-identical to the CPU oracle (SURVEY.md §7 hard part #5).  The driver
+additionally exercises __graft_entry__.dryrun_multichip out-of-process.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from corda_tpu.crypto import ref_ed25519 as ref
+from corda_tpu.ops import sharded
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _sig_fixture(n):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        sk = bytes([(i % 255) + 1]) * 32
+        pk = ref.public_key(sk)
+        m = b"shard-%d" % i
+        s = ref.sign(sk, m)
+        if i % 3 == 2:  # corrupt a third: R byte, S byte, or pubkey
+            which = i % 9
+            if which == 2:
+                s = bytes([s[0] ^ 1]) + s[1:]
+            elif which == 5:
+                s = s[:40] + bytes([s[40] ^ 1]) + s[41:]
+            else:
+                pk = bytes([pk[0] ^ 1]) + pk[1:]
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(s)
+    return pks, msgs, sigs
+
+
+def test_sharded_verify_matches_oracle():
+    mesh = sharded.make_mesh(8)
+    pks, msgs, sigs = _sig_fixture(19)  # ragged: exercises pad-and-mask
+    got = sharded.verify_batch_sharded(pks, msgs, sigs, mesh)
+    want = np.array([ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)])
+    assert got.tolist() == want.tolist()
+    assert want.sum() not in (0, len(want))  # fixture mixes accept and reject
+
+
+def test_sharded_rejects_malformed_without_raising():
+    mesh = sharded.make_mesh(8)
+    pks, msgs, sigs = _sig_fixture(4)
+    pks[1] = b"\x01" * 7        # wrong-length key
+    sigs[2] = b"\x02" * 11      # wrong-length sig
+    got = sharded.verify_batch_sharded(pks, msgs, sigs, mesh)
+    assert got[0] and not got[1] and not got[2]
+
+
+def test_pad_to_devices():
+    assert sharded.pad_to_devices(1, 8) == 8
+    assert sharded.pad_to_devices(8, 8) == 8
+    assert sharded.pad_to_devices(9, 8) == 16
+    assert sharded.pad_to_devices(0, 8) == 8

@@ -1,0 +1,214 @@
+"""Real nodes over real localhost TCP sockets + sqlite — the production tier.
+
+Mirrors the reference's integration tier (reference: node/src/integration-test,
+driver DSL at node/.../driver/Driver.kt:56-107) in-process: each Node owns its
+own sqlite file and TCP listener; the test round-robins run_once() as the
+scheduler, so delivery order is still deterministic enough to assert on.
+
+Covers VERDICT round-1 items 4 (durable node with new-process semantics) and
+5 (real transport: durable outbox, retry, dedupe, 2-node + notary smoke).
+"""
+
+import time
+
+import pytest
+
+from corda_tpu.flows.notary import NotaryClientFlow, NotaryException
+from corda_tpu.node.config import BatchConfig, NodeConfig
+from corda_tpu.node.node import Node
+from corda_tpu.testing.dummies import DummyContract
+
+
+def make_node(tmp_path, name, notary="none", netmap="netmap.json", **kw):
+    config = NodeConfig(
+        name=name,
+        base_dir=tmp_path / name,
+        port=0,
+        notary=notary,
+        network_map=tmp_path / netmap,
+        batch=BatchConfig(max_sigs=kw.pop("max_sigs", 4096),
+                          max_wait_ms=kw.pop("max_wait_ms", 2.0)),
+        **kw,
+    )
+    return Node(config).start()
+
+
+def pump_until(nodes, predicate, timeout=15.0):
+    """Round-robin run_once across nodes until predicate() or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for node in nodes:
+            node.run_once(timeout=0.01)
+            node.refresh_netmap()
+        if predicate():
+            return
+    raise AssertionError("timed out waiting for network to settle")
+
+
+def issue_and_move(alice, notary_identity, magic=1):
+    builder = DummyContract.generate_initial(
+        alice.identity.ref(b"\x01"), magic, notary_identity)
+    builder.sign_with(alice.key)
+    issue_stx = builder.to_signed_transaction()
+    alice.services.record_transactions([issue_stx])
+    move = DummyContract.move(issue_stx.tx.out_ref(0),
+                              alice.identity.owning_key)
+    move.sign_with(alice.key)
+    return move.to_signed_transaction(check_sufficient_signatures=False)
+
+
+class TestTcpNotarisation:
+    def test_two_nodes_plus_notary_smoke(self, tmp_path):
+        notary = make_node(tmp_path, "Notary", notary="simple")
+        alice = make_node(tmp_path, "Alice")
+        bob = make_node(tmp_path, "Bob")
+        nodes = [notary, alice, bob]
+        try:
+            for n in nodes:
+                n.refresh_netmap()
+            stx = issue_and_move(alice, notary.identity)
+            handle = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(nodes, lambda: handle.result.done)
+            sig = handle.result.result()
+            assert sig.by in notary.identity.owning_key.keys
+            sig.verify(stx.id.bytes)
+            assert notary.uniqueness_provider.committed_count == 1
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_double_spend_rejected_across_tcp(self, tmp_path):
+        notary = make_node(tmp_path, "Notary", notary="simple")
+        alice = make_node(tmp_path, "Alice")
+        nodes = [notary, alice]
+        try:
+            for n in nodes:
+                n.refresh_netmap()
+            builder = DummyContract.generate_initial(
+                alice.identity.ref(b"\x01"), 5, notary.identity)
+            builder.sign_with(alice.key)
+            issue_stx = builder.to_signed_transaction()
+            alice.services.record_transactions([issue_stx])
+            prior = issue_stx.tx.out_ref(0)
+
+            m1 = DummyContract.move(prior, alice.identity.owning_key)
+            m1.sign_with(alice.key)
+            stx1 = m1.to_signed_transaction(check_sufficient_signatures=False)
+            m2 = DummyContract.move(prior, notary.identity.owning_key)
+            m2.sign_with(alice.key)
+            stx2 = m2.to_signed_transaction(check_sufficient_signatures=False)
+            assert stx1.id != stx2.id
+
+            h1 = alice.start_flow(NotaryClientFlow(stx1))
+            pump_until(nodes, lambda: h1.result.done)
+            h1.result.result()
+
+            h2 = alice.start_flow(NotaryClientFlow(stx2))
+            pump_until(nodes, lambda: h2.result.done)
+            with pytest.raises(NotaryException) as err:
+                h2.result.result()
+            assert "used in another transaction" in str(err.value)
+        finally:
+            for n in nodes:
+                n.stop()
+
+def test_notary_restart_new_process_semantics(tmp_path):
+    """Kill the notary node (drop every object), rebuild purely from its
+    base_dir, and verify (a) the commit log survived sqlite-durably and (b) a
+    notarisation started while it was down completes after rebirth (durable
+    outbox + bridge retry — store-and-forward across a peer restart)."""
+    notary = make_node(tmp_path, "Notary", notary="simple")
+    alice = make_node(tmp_path, "Alice")
+    survivors = [alice]
+    try:
+        for n in (notary, alice):
+            n.refresh_netmap()
+        stx = issue_and_move(alice, notary.identity, magic=9)
+        h = alice.start_flow(NotaryClientFlow(stx))
+        pump_until([notary, alice], lambda: h.result.done)
+        h.result.result()
+        assert notary.uniqueness_provider.committed_count == 1
+        notary_config = notary.config
+        notary_identity = notary.identity
+
+        # -- crash: drop every in-memory object -----------------------------
+        notary.stop()
+        del notary
+        time.sleep(0.05)
+
+        # While down, Alice fires a second notarisation; the send parks in
+        # her durable outbox and the bridge retries.
+        stx2 = issue_and_move(alice, notary_identity, magic=10)
+        h2 = alice.start_flow(NotaryClientFlow(stx2))
+        for _ in range(5):
+            alice.run_once(timeout=0.01)
+        assert not h2.result.done  # notary is down; flow is parked
+
+        # -- rebirth purely from the base_dir (fresh port; netmap updates) --
+        reborn = Node(NodeConfig(
+            name=notary_config.name,
+            base_dir=notary_config.base_dir,
+            port=0,
+            notary="simple",
+            network_map=notary_config.network_map,
+        )).start()
+        survivors.append(reborn)
+        assert reborn.identity == notary_identity  # key survived on disk
+        assert reborn.uniqueness_provider.committed_count == 1  # log survived
+
+        pump_until([alice, reborn], lambda: h2.result.done)
+        sig2 = h2.result.result()
+        sig2.verify(stx2.id.bytes)
+        assert reborn.uniqueness_provider.committed_count == 2
+    finally:
+        for n in survivors:
+            n.stop()
+
+
+class TestKillAtStepSqlite:
+    """Kill-at-every-step re-run against the DURABLE stack: sqlite checkpoint
+    storage + TCP transport, with rebirth strictly from the base_dir (no
+    object hand-over — the new-process semantics VERDICT r1 asked for)."""
+
+    @pytest.mark.parametrize("crash_after", [1, 2, 3])
+    @pytest.mark.parametrize("victim", ["client", "notary"])
+    def test_crash_at_step(self, tmp_path, crash_after, victim):
+        notary = make_node(tmp_path, "Notary", notary="simple")
+        alice = make_node(tmp_path, "Alice")
+        nodes = {"notary": notary, "client": alice}
+        try:
+            for n in nodes.values():
+                n.refresh_netmap()
+            stx = issue_and_move(alice, notary.identity, magic=crash_after)
+            alice.start_flow(NotaryClientFlow(stx))
+
+            dispatched = 0
+            crashed = False
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                for node in list(nodes.values()):
+                    dispatched += node.run_once(timeout=0.01)
+                if not crashed and dispatched >= crash_after:
+                    crashed = True
+                    dead = nodes[victim]
+                    config = dead.config
+                    dead.stop()
+                    del dead, nodes[victim]
+                    # Rebirth purely from disk.
+                    nodes[victim] = Node(NodeConfig(
+                        name=config.name,
+                        base_dir=config.base_dir,
+                        port=0,
+                        notary=config.notary,
+                        network_map=config.network_map,
+                    )).start()
+                if nodes["notary"].uniqueness_provider.committed_count == 1 \
+                        and not any(n.smm.flows for n in nodes.values()):
+                    break
+            assert crashed, "network settled before the crash point"
+            assert nodes["notary"].uniqueness_provider.committed_count == 1, (
+                f"crash_after={crash_after} victim={victim}: "
+                "protocol did not complete")
+        finally:
+            for n in nodes.values():
+                n.stop()
