@@ -1,0 +1,15 @@
+"""Finance layer: fungible asset contracts and trading flows.
+
+Capability match for the reference's finance module (reference: finance/
+src/main/kotlin/net/corda/contracts/...): Amount arithmetic, the Cash
+contract, and TwoPartyTradeFlow delivery-versus-payment.
+"""
+
+from .amount import Amount
+from .cash import Cash, CashExit, CashIssue, CashMove, CashState
+from .trade import BuyerFlow, SellerFlow, SellerTradeInfo
+
+__all__ = [
+    "Amount", "Cash", "CashState", "CashIssue", "CashMove", "CashExit",
+    "SellerFlow", "BuyerFlow", "SellerTradeInfo",
+]

@@ -121,6 +121,22 @@ class VerifyTxRequest:
     allowed_to_be_missing: tuple[CompositeKey, ...] = ()
 
 
+@dataclass(frozen=True)
+class ServiceRequest:
+    """Suspend on an asynchronous node service (e.g. the Raft commit log):
+    `start()` launches the operation and returns a poll callable; the node's
+    run loop polls it each round — poll() returns None while pending, a value
+    when done, or raises. The single-threaded cooperative design forbids a
+    flow from blocking in-place (that would starve the very message pump the
+    service needs), so this is the async seam.
+
+    Not serialized: a flow restored from a checkpoint re-reaches the yield
+    and re-launches the operation, so start() must be idempotent (as the
+    replicated first-committer-wins commit is)."""
+
+    start: Callable[[], Callable[[], Any]]
+
+
 # ---------------------------------------------------------------------------
 # Flow whitelist registry — the analogue of FlowLogicRefFactory
 # (reference: core/.../flows/FlowLogicRef.kt:25-172)
@@ -215,6 +231,10 @@ class FlowLogic:
         self, stx: "SignedTransaction", *allowed_to_be_missing: CompositeKey
     ) -> VerifyTxRequest:
         return VerifyTxRequest(stx, tuple(allowed_to_be_missing))
+
+    def service_request(self, start: Callable) -> ServiceRequest:
+        """Suspend on an async node service; see ServiceRequest."""
+        return ServiceRequest(start)
 
     def sub_flow(
         self, flow: "FlowLogic", share_parent_sessions: bool = False

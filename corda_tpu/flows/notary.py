@@ -188,7 +188,7 @@ class NotaryServiceFlow(FlowLogic):
             wtx = stx.tx
             self._validate_timestamp(wtx)
             yield from self.before_commit(stx, req_identity)
-            self._commit_input_states(wtx, req_identity)
+            yield from self._commit_input_states(wtx, req_identity)
             sig = self.service.sign(stx.id.bytes)
             result = NotarySuccess(sig)
         except NotaryException as e:
@@ -227,12 +227,23 @@ class NotaryServiceFlow(FlowLogic):
         return
         yield  # pragma: no cover — makes this a generator for yield-from
 
-    def _commit_input_states(self, wtx, req_identity: Party) -> None:
+    def _commit_input_states(self, wtx, req_identity: Party):
+        """Commit via the uniqueness provider. Async providers (the Raft
+        cluster) expose commit_async -> poll; the flow suspends on it so the
+        node keeps pumping consensus traffic (blocking in-place would starve
+        the very message loop the quorum round needs). Generator either way
+        (yield-from'd by call())."""
         from ..node.services.api import UniquenessException
         from ..serialization.codec import serialize
 
+        provider = self.service.uniqueness_provider
         try:
-            self.service.uniqueness_provider.commit(wtx.inputs, wtx.id, req_identity)
+            if hasattr(provider, "commit_async"):
+                yield self.service_request(
+                    lambda: provider.commit_async(
+                        wtx.inputs, wtx.id, req_identity))
+            else:
+                provider.commit(wtx.inputs, wtx.id, req_identity)
         except UniquenessException as e:
             conflict_data = serialize(e.error)
             signed = SignedData(conflict_data, self.service.sign(conflict_data.bytes))

@@ -55,31 +55,25 @@ class ResolveTransactionsFlow(FlowLogic):
     transaction_count_limit = 5000  # DoS bound (ResolveTransactionsFlow.kt:78-80)
 
     def __init__(self, tx, other_side: Party):
-        # tx: WireTransaction (check deps only) or SignedTransaction (also
-        # verify the tx itself against its history).
+        # tx: WireTransaction (check deps only), SignedTransaction (also
+        # verify the tx itself against its history), or a tuple of
+        # SecureHash tx ids to fetch+verify directly (the reference's
+        # Set<SecureHash> constructor, ResolveTransactionsFlow.kt:88-92).
         self.tx = tx
         self.other_side = other_side
 
     def call(self):
+        if isinstance(self.tx, (tuple, frozenset, set)):
+            downloads = yield from self._download_dependencies(set(self.tx))
+            results = yield from self._verify_and_record(downloads)
+            return results
         stx = self.tx if isinstance(self.tx, SignedTransaction) else None
         wtx = stx.tx if stx is not None else self.tx
         assert isinstance(wtx, WireTransaction)
         dep_hashes = {ref.txhash for ref in wtx.inputs}
 
         downloads = yield from self._download_dependencies(dep_hashes)
-        new_txns = topological_sort(downloads)
-
-        results = []
-        for dep_stx in new_txns:
-            # Batched signature math + completeness. NO allowances: committed
-            # history must carry every required signature INCLUDING the
-            # notary's (the reference verifies dependencies strictly,
-            # ResolveTransactionsFlow.kt:105-111).
-            yield self.verify_signatures_batched(dep_stx)
-            ltx = dep_stx.tx.to_ledger_transaction(self.service_hub)
-            ltx.verify()
-            self.service_hub.record_transactions([dep_stx])
-            results.append(ltx)
+        results = yield from self._verify_and_record(downloads)
 
         yield from self._fetch_missing_attachments([wtx])
         if stx is not None:
@@ -87,6 +81,20 @@ class ResolveTransactionsFlow(FlowLogic):
         ltx = wtx.to_ledger_transaction(self.service_hub)
         ltx.verify()
         results.append(ltx)
+        return results
+
+    def _verify_and_record(self, downloads):
+        """Verify + record downloaded dependencies, deepest-first. Batched
+        signature math + completeness; NO allowances: committed history must
+        carry every required signature INCLUDING the notary's (the reference
+        verifies dependencies strictly, ResolveTransactionsFlow.kt:105-111)."""
+        results = []
+        for dep_stx in topological_sort(downloads):
+            yield self.verify_signatures_batched(dep_stx)
+            ltx = dep_stx.tx.to_ledger_transaction(self.service_hub)
+            ltx.verify()
+            self.service_hub.record_transactions([dep_stx])
+            results.append(ltx)
         return results
 
     def _download_dependencies(self, deps_to_check: set[SecureHash]):

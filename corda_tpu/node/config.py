@@ -39,10 +39,15 @@ class NodeConfig:
     base_dir: Path
     host: str = "127.0.0.1"
     port: int = 0  # 0 = ephemeral (the netmap records the real port)
-    notary: str = "none"  # none | simple | validating
+    # none | simple | validating | raft-simple | raft-validating
+    notary: str = "none"
+    # For raft-* notaries: the names of ALL cluster members (incl. this node).
+    raft_cluster: tuple[str, ...] = ()
     network_map: Path | None = None  # shared netmap file
     verifier: str = "cpu"  # cpu | jax | jax-shadow
     batch: BatchConfig = field(default_factory=BatchConfig)
+    # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
+    rpc_users: tuple = ()
 
     @staticmethod
     def load(path: str | os.PathLike) -> "NodeConfig":
@@ -55,14 +60,19 @@ class NodeConfig:
     @staticmethod
     def from_dict(raw: dict, default_dir: Path | None = None) -> "NodeConfig":
         base = Path(raw.get("base_dir", default_dir or "."))
-        known = {"name", "base_dir", "host", "port", "notary", "network_map",
-                 "verifier", "batch"}
+        known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
+                 "network_map", "verifier", "batch", "rpc_users"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
         notary = raw.get("notary", "none")
-        if notary not in ("none", "simple", "validating"):
-            raise ValueError(f"notary must be none|simple|validating, got {notary!r}")
+        valid_notary = ("none", "simple", "validating", "raft-simple",
+                        "raft-validating")
+        if notary not in valid_notary:
+            raise ValueError(
+                f"notary must be one of {'|'.join(valid_notary)}, got {notary!r}")
+        if notary.startswith("raft") and not raw.get("raft_cluster"):
+            raise ValueError("raft-* notaries need a raft_cluster name list")
         nm = raw.get("network_map")
         batch = raw.get("batch", {})
         return NodeConfig(
@@ -71,6 +81,7 @@ class NodeConfig:
             host=raw.get("host", "127.0.0.1"),
             port=int(raw.get("port", 0)),
             notary=notary,
+            raft_cluster=tuple(raw.get("raft_cluster", ())),
             network_map=(base / nm if nm and not os.path.isabs(nm) else
                          Path(nm) if nm else None),
             verifier=raw.get("verifier", "cpu"),
@@ -78,6 +89,8 @@ class NodeConfig:
                 max_sigs=int(batch.get("max_sigs", 4096)),
                 max_wait_ms=float(batch.get("max_wait_ms", 2.0)),
             ),
+            rpc_users=tuple(
+                dict(u) for u in raw.get("rpc_users", ())),
         )
 
 

@@ -94,9 +94,9 @@ class Node:
 
         # -- advertised services ------------------------------------------
         services = ()
-        if config.notary == "simple":
+        if config.notary in ("simple", "raft-simple"):
             services = (ServiceInfo(SIMPLE_NOTARY),)
-        elif config.notary == "validating":
+        elif config.notary in ("validating", "raft-validating"):
             services = (ServiceInfo(VALIDATING_NOTARY),)
         self.info = NodeInfo(
             address=self.messaging.my_address,
@@ -135,15 +135,59 @@ class Node:
         # -- notary --------------------------------------------------------
         self.uniqueness_provider = None
         self.notary_service = None
+        self.raft_member = None
         if config.notary != "none":
-            self.uniqueness_provider = PersistentUniquenessProvider(self.db)
-            cls = (ValidatingNotaryService if config.notary == "validating"
+            if config.notary.startswith("raft"):
+                from .services.raft import (
+                    RaftMember,
+                    RaftUniquenessProvider,
+                    make_apply_command,
+                )
+
+                self.raft_member = RaftMember(
+                    name=config.name,
+                    peers={},  # populated from the netmap on refresh
+                    messaging=self.messaging,
+                    db=self.db,
+                    apply_command=make_apply_command(self.db),
+                )
+                self.uniqueness_provider = RaftUniquenessProvider(
+                    self.raft_member, pump=self._raft_pump)
+            else:
+                self.uniqueness_provider = PersistentUniquenessProvider(self.db)
+            cls = (ValidatingNotaryService
+                   if config.notary.endswith("validating")
                    else SimpleNotaryService)
             self.notary_service = cls(
                 self.smm, self.services, self.identity, self.key,
                 self.uniqueness_provider)
 
+        # -- vault rebuild + scheduler ------------------------------------
+        # The vault is an in-memory projection of durable transaction
+        # storage: replay it so a restarted node sees its unconsumed states
+        # (the reference's vault is DB-backed; same post-restart capability).
+        stored = self.services.storage_service.validated_transactions \
+            .all_transactions()
+        if stored:
+            self.services.vault_service.notify_all(stored)
+        from .services.scheduler import NodeSchedulerService
+
+        self.scheduler = NodeSchedulerService(
+            self.smm, self.services.vault_service)
+
         install_data_vending(self.smm)
+
+        # -- RPC (reference: RPCDispatcher.kt, RPCUserService.kt) ----------
+        self.rpc = None
+        if config.rpc_users:
+            from .rpc import RpcDispatcher, RpcUser
+
+            users = tuple(
+                RpcUser(u["username"], u["password"],
+                        tuple(u.get("permissions", ())))
+                for u in config.rpc_users)
+            self.rpc = RpcDispatcher(self, users)
+
         self._started = False
 
     # -- network map -------------------------------------------------------
@@ -168,6 +212,18 @@ class Node:
             info = entry.node_info()
             self.identity_service.register_identity(info.legal_identity)
             self.network_map_cache.add_node(info)
+            if (self.raft_member is not None
+                    and entry.name in self.config.raft_cluster
+                    and entry.name != self.config.name):
+                self.raft_member.peers[entry.name] = info.address
+
+    def _raft_pump(self) -> None:
+        """Drive consensus while a flow blocks in commit(): deliver raft
+        messages (SMM session dispatch is re-entrancy-guarded and just
+        queues) and advance election/heartbeat timers."""
+        self.messaging.pump(timeout=0.001)
+        if self.raft_member is not None:
+            self.raft_member.tick()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -192,6 +248,10 @@ class Node:
                         + batch.max_wait_ms / 1e3)
             wait = max(0.0, min(timeout, deadline - time.monotonic()))
         n = self.messaging.pump(timeout=wait)
+        if self.raft_member is not None:
+            self.raft_member.tick()
+        self.smm.poll_services()
+        self.scheduler.tick()
         pending = self.smm.verify_pending_sigs
         if pending and (
             pending >= batch.max_sigs

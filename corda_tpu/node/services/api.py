@@ -335,11 +335,17 @@ class ServiceHub:
         return stx.tx.outputs[ref.index]
 
     def record_transactions(self, txs) -> None:
-        """Store + vault-notify observed transactions (ServiceHub.kt:38-46)."""
-        txs = list(txs)
-        for stx in txs:
-            self.storage_service.validated_transactions.add_transaction(stx)
-        self.vault_service.notify_all(txs)
+        """Store + vault-notify observed transactions (ServiceHub.kt:38-46).
+
+        Idempotent: transactions already in durable storage are skipped, so
+        checkpoint-replayed flows re-recording a dependency cannot resurrect
+        vault states that a later transaction already consumed."""
+        storage = self.storage_service.validated_transactions
+        fresh = [stx for stx in txs if storage.get_transaction(stx.id) is None]
+        for stx in fresh:
+            storage.add_transaction(stx)
+        if fresh:
+            self.vault_service.notify_all(fresh)
 
     @property
     def legal_identity_key(self) -> KeyPair:

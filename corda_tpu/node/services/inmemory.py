@@ -102,6 +102,9 @@ class InMemoryTransactionStorage(TransactionStorage):
     def get_transaction(self, id: SecureHash):
         return self._txs.get(id)
 
+    def all_transactions(self):
+        return list(self._txs.values())  # dicts preserve insertion order
+
     def subscribe(self, observer: Callable) -> None:
         self._observers.append(observer)
 

@@ -185,6 +185,13 @@ class DBTransactionStorage(TransactionStorage):
         ).fetchone()
         return None if row is None else deserialize(bytes(row[0]))
 
+    def all_transactions(self):
+        """Every stored transaction in insertion order (vault rebuild after
+        a restart replays these through notify_all)."""
+        rows = self._db.conn.execute(
+            "SELECT blob FROM transactions ORDER BY rowid").fetchall()
+        return [deserialize(bytes(r[0])) for r in rows]
+
     def subscribe(self, observer: Callable) -> None:
         self._observers.append(observer)
 

@@ -1,0 +1,551 @@
+"""Raft-replicated uniqueness provider — the distributed notary commit log.
+
+Capability match for the reference's Raft tier (reference:
+node/src/main/kotlin/net/corda/node/services/transactions/
+RaftUniquenessProvider.kt:44-115 and DistributedImmutableMap.kt:24-106, built
+on Copycat/Atomix): a cluster of notary nodes replicates a first-committer-
+wins input map through leader-based consensus, so notarisation survives the
+loss of a minority of cluster members.
+
+Design (idiomatic to this framework, not a Copycat port):
+  * consensus state machine implements the Raft paper's core: randomized
+    election timeouts, RequestVote/AppendEntries over the node's existing
+    MessagingService (TCP in production, the in-memory fake in tests — the
+    reference runs its own Netty transport; ours rides the one transport);
+  * the replicated command is PutAll{refs -> ConsumingTx}; apply = the same
+    first-committer-wins check/insert as PersistentUniquenessProvider, so
+    conflict detection is byte-identical to the single-node path;
+  * log + term/votedFor persist in the NodeDatabase (raft_log/raft_meta
+    tables) — a restarted member rejoins with its log intact;
+  * RaftUniquenessProvider.commit() submits to the local member; a follower
+    forwards to the leader. While waiting it pumps the node's messaging so
+    consensus traffic flows — SMM flow dispatch is re-entrancy-guarded, so
+    session messages queue up and run after the flow step completes.
+
+Timing is injected (clock callable) so tests can drive elections
+deterministically fast.
+"""
+
+from __future__ import annotations
+
+import random
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from ...crypto.hashes import SecureHash
+from ...crypto.party import Party
+from ...serialization.codec import deserialize, register, serialize
+from ..messaging.api import MessagingService, TopicSession
+from .api import (
+    ConsumingTx,
+    UniquenessConflict,
+    UniquenessException,
+    UniquenessProvider,
+)
+
+RAFT_TOPIC = "platform.raft"
+
+_RAFT_SCHEMA = """
+CREATE TABLE IF NOT EXISTS raft_log (
+    idx  INTEGER PRIMARY KEY,
+    term INTEGER NOT NULL,
+    blob BLOB NOT NULL
+);
+CREATE TABLE IF NOT EXISTS raft_meta (
+    singleton INTEGER PRIMARY KEY CHECK (singleton = 1),
+    term      INTEGER NOT NULL,
+    voted_for TEXT
+);
+"""
+
+
+# -- wire messages ----------------------------------------------------------
+
+
+@register
+@dataclass(frozen=True)
+class PutAllCommand:
+    """The replicated command: claim `refs` for tx_id (DistributedImmutableMap
+    putAll capability)."""
+
+    refs: tuple
+    tx_id: SecureHash
+    caller: Party
+    request_id: bytes  # correlates the client's reply
+
+
+@register
+@dataclass(frozen=True)
+class RequestVote:
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@register
+@dataclass(frozen=True)
+class VoteReply:
+    term: int
+    granted: bool
+    voter: str
+
+
+@register
+@dataclass(frozen=True)
+class AppendEntries:
+    term: int
+    leader: str
+    prev_index: int
+    prev_term: int
+    entries: tuple  # ((term, PutAllCommand|None), ...) — None = no-op entry
+    leader_commit: int
+
+
+@register
+@dataclass(frozen=True)
+class AppendReply:
+    term: int
+    success: bool
+    match_index: int
+    follower: str
+
+
+@register
+@dataclass(frozen=True)
+class ClientCommit:
+    """Follower->leader forwarding of a client commit."""
+
+    command: PutAllCommand
+    reply_to: str
+
+
+@register
+@dataclass(frozen=True)
+class ClientReply:
+    request_id: bytes
+    ok: bool
+    conflict: UniquenessConflict | None
+    leader_hint: str | None
+
+
+class RaftMember:
+    """One member of the notary cluster's consensus group."""
+
+    ELECTION_TIMEOUT = (0.15, 0.30)  # seconds, randomized per election
+    HEARTBEAT = 0.05
+
+    def __init__(
+        self,
+        name: str,
+        peers: dict[str, Any],  # name -> transport address (excluding self)
+        messaging: MessagingService,
+        db,  # NodeDatabase
+        apply_command: Callable[[PutAllCommand], UniquenessConflict | None],
+        clock: Callable[[], float] = _time.monotonic,
+        rng: random.Random | None = None,
+        timeout_scale: float = 1.0,
+    ):
+        self.name = name
+        self.peers = dict(peers)
+        self.messaging = messaging
+        self.db = db
+        self.apply_command = apply_command
+        self.clock = clock
+        self.rng = rng or random.Random(hash(name) & 0xFFFF)
+        self.scale = timeout_scale
+
+        with db.lock:
+            db.conn.executescript(_RAFT_SCHEMA)
+            row = db.conn.execute(
+                "SELECT term, voted_for FROM raft_meta WHERE singleton=1"
+            ).fetchone()
+            if row is None:
+                db.conn.execute(
+                    "INSERT INTO raft_meta (singleton, term, voted_for) "
+                    "VALUES (1, 0, NULL)")
+                db.conn.commit()
+                self.term, self.voted_for = 0, None
+            else:
+                self.term, self.voted_for = row[0], row[1]
+        self.role = "follower"
+        self.leader_name: str | None = None
+        self.commit_index = int(db.get_setting("raft_commit_index") or 0)
+        self.last_applied = int(db.get_setting("raft_last_applied") or 0)
+        self._votes: set[str] = set()
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._last_heartbeat = self.clock()
+        self._election_deadline = self._next_election_deadline()
+        # request_id -> ClientReply for commits decided at this member.
+        # Bounded: late/duplicate replies for abandoned requests must not
+        # accumulate on a long-running cluster.
+        self.decided: dict[bytes, ClientReply] = {}
+        self._decided_cap = 4096
+        # Leader-side dedupe: request_ids appended to the log but not yet
+        # applied — a client's periodic resubmission (liveness across leader
+        # changes) must not append duplicate log entries on a slow quorum.
+        self._appending: set[bytes] = set()
+        messaging.add_message_handler(RAFT_TOPIC, 0, self._on_message)
+
+    # -- persistence -------------------------------------------------------
+
+    def _save_meta(self) -> None:
+        with self.db.lock:
+            self.db.conn.execute(
+                "UPDATE raft_meta SET term=?, voted_for=? WHERE singleton=1",
+                (self.term, self.voted_for))
+            self.db.conn.commit()
+
+    def _log_last(self) -> tuple[int, int]:
+        row = self.db.conn.execute(
+            "SELECT idx, term FROM raft_log ORDER BY idx DESC LIMIT 1"
+        ).fetchone()
+        return (row[0], row[1]) if row else (0, 0)
+
+    def _log_term_at(self, idx: int) -> int | None:
+        if idx == 0:
+            return 0
+        row = self.db.conn.execute(
+            "SELECT term FROM raft_log WHERE idx=?", (idx,)).fetchone()
+        return None if row is None else row[0]
+
+    def _log_append(self, idx: int, term: int, command) -> None:
+        with self.db.lock:
+            self.db.conn.execute(
+                "INSERT OR REPLACE INTO raft_log (idx, term, blob) "
+                "VALUES (?, ?, ?)", (idx, term, serialize(command).bytes))
+            self.db.conn.commit()
+
+    def _log_truncate_from(self, idx: int) -> None:
+        with self.db.lock:
+            self.db.conn.execute("DELETE FROM raft_log WHERE idx >= ?", (idx,))
+            self.db.conn.commit()
+
+    def _log_entries_from(self, idx: int, limit: int = 64):
+        rows = self.db.conn.execute(
+            "SELECT idx, term, blob FROM raft_log WHERE idx >= ? "
+            "ORDER BY idx LIMIT ?", (idx, limit)).fetchall()
+        return [(r[0], r[1], deserialize(bytes(r[2]))) for r in rows]
+
+    # -- timers (driven from the node's run loop) --------------------------
+
+    def _next_election_deadline(self) -> float:
+        lo, hi = self.ELECTION_TIMEOUT
+        return self.clock() + self.rng.uniform(lo, hi) * self.scale
+
+    def tick(self) -> None:
+        now = self.clock()
+        if self.role == "leader":
+            if now - self._last_heartbeat >= self.HEARTBEAT * self.scale:
+                self._broadcast_append()
+        elif now >= self._election_deadline:
+            self._start_election()
+
+    # -- roles -------------------------------------------------------------
+
+    def _become_follower(self, term: int, leader: str | None = None) -> None:
+        if term > self.term:
+            self.term, self.voted_for = term, None
+            self._save_meta()
+        self.role = "follower"
+        if leader is not None:
+            self.leader_name = leader
+        self._election_deadline = self._next_election_deadline()
+
+    def _start_election(self) -> None:
+        self.term += 1
+        self.voted_for = self.name
+        self._save_meta()
+        self.role = "candidate"
+        self.leader_name = None
+        self._votes = {self.name}
+        self._election_deadline = self._next_election_deadline()
+        last_idx, last_term = self._log_last()
+        msg = RequestVote(self.term, self.name, last_idx, last_term)
+        for peer in self.peers.values():
+            self._send(peer, msg)
+        self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        if self.role != "candidate":
+            return
+        if len(self._votes) * 2 > len(self.peers) + 1:
+            self.role = "leader"
+            self.leader_name = self.name
+            last_idx, _ = self._log_last()
+            self._next_index = {p: last_idx + 1 for p in self.peers}
+            self._match_index = {p: 0 for p in self.peers}
+            self._broadcast_append()  # assert leadership immediately
+
+    # -- client interface --------------------------------------------------
+
+    def submit(self, command: PutAllCommand) -> None:
+        """Start replication of a command; the outcome appears in
+        self.decided[request_id] once committed (possibly ok=False with a
+        leader hint if this member cannot get it committed)."""
+        if self.role == "leader":
+            if command.request_id in self._appending:
+                return  # already replicating; resubmission is a no-op
+            self._appending.add(command.request_id)
+            last_idx, _ = self._log_last()
+            self._log_append(last_idx + 1, self.term, command)
+            self._broadcast_append()
+            self._advance_commit()
+        elif self.leader_name is not None and self.leader_name in self.peers:
+            self._send(self.peers[self.leader_name],
+                       ClientCommit(command, self.name))
+        else:
+            self.decided[command.request_id] = ClientReply(
+                command.request_id, False, None, self.leader_name)
+
+    # -- message handling --------------------------------------------------
+
+    def _send(self, to, payload) -> None:
+        self.messaging.send(TopicSession(RAFT_TOPIC, 0),
+                            serialize(payload).bytes, to)
+
+    def _on_message(self, message) -> None:
+        try:
+            payload = deserialize(message.data)
+        except Exception:
+            return
+        if isinstance(payload, RequestVote):
+            self._on_request_vote(payload, message.sender)
+        elif isinstance(payload, VoteReply):
+            self._on_vote_reply(payload)
+        elif isinstance(payload, AppendEntries):
+            self._on_append(payload, message.sender)
+        elif isinstance(payload, AppendReply):
+            self._on_append_reply(payload)
+        elif isinstance(payload, ClientCommit):
+            self._on_client_commit(payload)
+        elif isinstance(payload, ClientReply):
+            self._record_decision(payload.request_id, payload)
+
+    def _on_request_vote(self, rv: RequestVote, sender) -> None:
+        if rv.term > self.term:
+            self._become_follower(rv.term)
+        granted = False
+        if rv.term == self.term and self.voted_for in (None, rv.candidate):
+            last_idx, last_term = self._log_last()
+            up_to_date = (rv.last_log_term, rv.last_log_index) >= (
+                last_term, last_idx)
+            if up_to_date:
+                granted = True
+                self.voted_for = rv.candidate
+                self._save_meta()
+                self._election_deadline = self._next_election_deadline()
+        self._send(sender, VoteReply(self.term, granted, self.name))
+
+    def _on_vote_reply(self, vr: VoteReply) -> None:
+        if vr.term > self.term:
+            self._become_follower(vr.term)
+            return
+        if self.role == "candidate" and vr.term == self.term and vr.granted:
+            self._votes.add(vr.voter)
+            self._maybe_win()
+
+    def _broadcast_append(self) -> None:
+        self._last_heartbeat = self.clock()
+        for peer_name, addr in self.peers.items():
+            nxt = self._next_index.get(peer_name, 1)
+            prev_idx = nxt - 1
+            prev_term = self._log_term_at(prev_idx) or 0
+            entries = tuple(
+                (term, cmd) for _idx, term, cmd in self._log_entries_from(nxt))
+            self._send(addr, AppendEntries(
+                self.term, self.name, prev_idx, prev_term, entries,
+                self.commit_index))
+
+    def _on_append(self, ae: AppendEntries, sender) -> None:
+        if ae.term < self.term:
+            self._send(sender, AppendReply(self.term, False, 0, self.name))
+            return
+        self._become_follower(ae.term, leader=ae.leader)
+        local_prev = self._log_term_at(ae.prev_index)
+        if local_prev is None or local_prev != ae.prev_term:
+            self._send(sender, AppendReply(self.term, False, 0, self.name))
+            return
+        idx = ae.prev_index
+        for term, cmd in ae.entries:
+            idx += 1
+            existing = self._log_term_at(idx)
+            if existing is not None and existing != term:
+                self._log_truncate_from(idx)
+                existing = None
+            if existing is None:
+                self._log_append(idx, term, cmd)
+        if ae.leader_commit > self.commit_index:
+            last_idx, _ = self._log_last()
+            self.commit_index = min(ae.leader_commit, last_idx)
+            self._apply_committed()
+        self._send(sender, AppendReply(self.term, True, idx, self.name))
+
+    def _on_append_reply(self, ar: AppendReply) -> None:
+        if ar.term > self.term:
+            self._become_follower(ar.term)
+            return
+        if self.role != "leader":
+            return
+        if ar.success:
+            self._match_index[ar.follower] = max(
+                self._match_index.get(ar.follower, 0), ar.match_index)
+            self._next_index[ar.follower] = ar.match_index + 1
+            self._advance_commit()
+        else:
+            self._next_index[ar.follower] = max(
+                1, self._next_index.get(ar.follower, 1) - 1)
+
+    _forward_replies: dict
+
+    def _on_client_commit(self, cc: ClientCommit) -> None:
+        if self.role == "leader":
+            if not hasattr(self, "_forward_replies"):
+                self._forward_replies = {}
+            # Remember where to send the decision, then replicate.
+            self._forward_replies[cc.command.request_id] = cc.reply_to
+            self.submit(cc.command)
+        else:
+            # Not the leader anymore: bounce with a hint so the origin
+            # re-routes after its next ticks.
+            addr = self.peers.get(cc.reply_to)
+            if addr is not None:
+                self._send(addr, ClientReply(
+                    cc.command.request_id, False, None, self.leader_name))
+
+    def _advance_commit(self) -> None:
+        if self.role != "leader":
+            return
+        last_idx, _ = self._log_last()
+        for n in range(self.commit_index + 1, last_idx + 1):
+            votes = 1 + sum(
+                1 for m in self._match_index.values() if m >= n)
+            if votes * 2 > len(self.peers) + 1 and \
+                    self._log_term_at(n) == self.term:
+                self.commit_index = n
+        self._apply_committed()
+
+    def _record_decision(self, request_id: bytes, reply: ClientReply) -> None:
+        self.decided[request_id] = reply
+        while len(self.decided) > self._decided_cap:
+            self.decided.pop(next(iter(self.decided)))
+
+    def _apply_committed(self) -> None:
+        applied_any = False
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            applied_any = True
+            entries = self._log_entries_from(self.last_applied, limit=1)
+            if not entries:
+                break
+            _idx, _term, cmd = entries[0]
+            conflict = self.apply_command(cmd)
+            reply = ClientReply(cmd.request_id, conflict is None, conflict,
+                                self.leader_name)
+            self._record_decision(cmd.request_id, reply)
+            self._appending.discard(cmd.request_id)
+            fwd = getattr(self, "_forward_replies", {}).pop(
+                cmd.request_id, None)
+            if fwd is not None and fwd in self.peers:
+                self._send(self.peers[fwd], reply)
+        if applied_any:  # no idle-heartbeat sqlite churn
+            self.db.set_setting("raft_commit_index", str(self.commit_index))
+            self.db.set_setting("raft_last_applied", str(self.last_applied))
+
+
+from ...utils.excheckpoint import register_flow_exception
+
+
+@register_flow_exception
+class CommitTimeoutException(Exception):
+    """The cluster could not commit within the deadline (no quorum/leader).
+    Distinct from UniquenessException: a timeout is retriable, a conflict is
+    final — surfacing one as the other would tell a client its transaction
+    double-spent when the cluster was merely degraded. Whitelisted for typed
+    checkpoint replay so flows can branch on it live and post-restore."""
+
+
+class RaftUniquenessProvider(UniquenessProvider):
+    """UniquenessProvider facade over a RaftMember (reference:
+    RaftUniquenessProvider.kt:44-115 — commit() submits PutAll and waits for
+    the replicated state machine's verdict).
+
+    The flow-facing path is commit_async(): it returns a poll callable the
+    node's run loop drives (ServiceRequest suspension), so a notary flow
+    never blocks the message pump that consensus itself rides on. The
+    synchronous commit() exists for direct/production use where the caller
+    may block while a pump callable runs the node."""
+
+    RESUBMIT_EVERY = 0.5  # sec; re-offer after leader changes (idempotent)
+
+    def __init__(self, member: RaftMember, pump: Callable[[], None],
+                 timeout: float = 10.0):
+        self.member = member
+        self._pump = pump  # drives messaging + raft ticks while waiting
+        self.timeout = timeout
+
+    def commit_async(self, states: Sequence, tx_id: SecureHash,
+                     caller_identity: Party) -> Callable[[], bool | None]:
+        import os
+
+        request_id = os.urandom(16)
+        command = PutAllCommand(tuple(states), tx_id, caller_identity,
+                                request_id)
+        state = {"deadline": _time.monotonic() + self.timeout,
+                 "submitted_at": 0.0}
+
+        def poll():
+            now = _time.monotonic()
+            reply = self.member.decided.pop(request_id, None)
+            if reply is not None:
+                if reply.ok:
+                    return True
+                if reply.conflict is not None:
+                    raise UniquenessException(reply.conflict)
+                state["submitted_at"] = 0.0  # no leader yet: resubmit below
+            if now >= state["deadline"]:
+                raise CommitTimeoutException(
+                    f"raft commit of {tx_id} not decided within "
+                    f"{self.timeout}s (leader: {self.member.leader_name})")
+            if (state["submitted_at"] == 0.0
+                    or now - state["submitted_at"] >= self.RESUBMIT_EVERY):
+                self.member.submit(command)
+                state["submitted_at"] = now
+            return None
+
+        return poll
+
+    def commit(self, states: Sequence, tx_id: SecureHash,
+               caller_identity: Party) -> None:
+        poll = self.commit_async(states, tx_id, caller_identity)
+        while True:
+            outcome = poll()
+            if outcome is not None:
+                return
+            self._pump()
+
+    @property
+    def committed_count(self) -> int:
+        (n,) = self.member.db.conn.execute(
+            "SELECT COUNT(*) FROM committed_states").fetchone()
+        return n
+
+
+def make_apply_command(db) -> Callable[[PutAllCommand], UniquenessConflict | None]:
+    """The replicated state machine's apply step: first-committer-wins over
+    the same committed_states table as the single-node provider. Idempotent
+    for re-applied entries (same tx claims same refs -> no conflict)."""
+    from .persistence import PersistentUniquenessProvider
+
+    single = PersistentUniquenessProvider(db)
+
+    def apply(cmd: PutAllCommand) -> UniquenessConflict | None:
+        try:
+            single.commit(list(cmd.refs), cmd.tx_id, cmd.caller)
+            return None
+        except UniquenessException as e:
+            return e.error
+
+    return apply

@@ -47,6 +47,7 @@ from ..flows.api import (
     ReceiveRequest,
     SendAndReceiveRequest,
     SendRequest,
+    ServiceRequest,
     UntrustworthyData,
     VerifyTxRequest,
     flow_registry,
@@ -178,6 +179,40 @@ class InMemoryCheckpointStorage(CheckpointStorage):
 # ---------------------------------------------------------------------------
 
 
+class EventLog:
+    """Bounded append-only event feed with ABSOLUTE cursors: old events are
+    evicted but cursor arithmetic stays valid, so RPC pollers
+    (state_machine_changes) never index a shifted list. Events are tuples —
+    ('add'|'remove', run_id) or ('progress', run_id, path)."""
+
+    def __init__(self, keep: int = 10_000):
+        self._keep = keep
+        self.base = 0  # absolute index of _events[0]
+        self._events: list[tuple] = []
+
+    def append(self, event: tuple) -> None:
+        self._events.append(event)
+        overflow = len(self._events) - self._keep
+        if overflow > 0:
+            del self._events[:overflow]
+            self.base += overflow
+
+    def since(self, cursor: int) -> tuple[int, tuple]:
+        """(new_cursor, events at absolute index >= cursor)."""
+        start = max(cursor - self.base, 0)
+        return (self.base + len(self._events), tuple(self._events[start:]))
+
+    # list-compat conveniences (tests introspect the feed directly)
+    def __len__(self):
+        return self.base + len(self._events)
+
+    def __iter__(self):
+        return iter(self._events)
+
+    def __getitem__(self, item):
+        return self._events[item]
+
+
 class FlowFuture:
     """Synchronous future resolved by the manager's pump."""
 
@@ -278,6 +313,7 @@ class FlowSession:
 _RUNNABLE = "runnable"
 _WAIT_RECEIVE = "wait_receive"
 _WAIT_VERIFY = "wait_verify"
+_WAIT_SERVICE = "wait_service"
 _DONE = "done"
 
 
@@ -471,6 +507,14 @@ class FlowStateMachine:
             self.state = _WAIT_VERIFY
             self.manager._enqueue_verify(self, request)
             return _PARKED
+        if isinstance(request, ServiceRequest):
+            if self.replaying:
+                return self._consume_replay_entry()
+            # Live (or restored): (re-)launch the async operation; the node's
+            # run loop polls it. start() must be idempotent across restarts.
+            self.state = _WAIT_SERVICE
+            self.manager._enqueue_service(self, request.start())
+            return _PARKED
         raise FlowException(f"flow yielded unknown request {request!r}")
 
     def _consume_replay_entry(self):
@@ -535,6 +579,17 @@ class FlowStateMachine:
         self.state = _RUNNABLE
         if ok:
             self.pending_value = self._record("v", None)
+        else:
+            self.pending_value = self._record("e", err=error)
+        self.manager._checkpoint(self)
+        self.manager._mark_runnable(self)
+
+    def deliver_service_result(self, value=None,
+                               error: BaseException | None = None) -> None:
+        assert self.state == _WAIT_SERVICE
+        self.state = _RUNNABLE
+        if error is None:
+            self.pending_value = self._record("v", value)
         else:
             self.pending_value = self._record("e", err=error)
         self.manager._checkpoint(self)
@@ -657,8 +712,10 @@ class StateMachineManager:
         self._verify_queue: list[tuple[FlowStateMachine, VerifyTxRequest]] = []
         self._verify_sig_count = 0
         self._verify_waiting_since = 0.0
+        self._service_queue: list[tuple[FlowStateMachine, Callable]] = []
+        self.recent_results: dict[bytes, FlowFuture] = {}
         self._pumping = False
-        self.changes: list[tuple[str, bytes]] = []  # (event, run_id) feed
+        self.changes = EventLog()  # bounded flow/progress event feed
         # Metrics (reference: StateMachineManager.kt:105-113)
         self.metrics = {"started": 0, "finished": 0, "checkpointing_rate": 0,
                         "verify_batches": 0, "verify_sigs": 0}
@@ -688,6 +745,12 @@ class StateMachineManager:
         fsm = FlowStateMachine(self, logic, run_id)
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
+        if logic.progress_tracker is not None:
+            # Surface step changes on the manager's change feed (the
+            # reference streams these to RPC, CordaRPCOps.kt:66-67).
+            logic.progress_tracker.subscribe(
+                lambda change, rid=run_id:
+                self.changes.append(("progress", rid, change.path)))
         self._checkpoint(fsm)
         self._mark_runnable(fsm)
         self.changes.append(("add", run_id))
@@ -796,6 +859,37 @@ class StateMachineManager:
     def verify_waiting_since(self) -> float:
         """monotonic() when the current micro-batch started accumulating."""
         return self._verify_waiting_since
+
+    # -- async service polling (Raft commit etc.) --------------------------
+
+    def _enqueue_service(self, fsm: FlowStateMachine, poll: Callable) -> None:
+        self._service_queue.append((fsm, poll))
+
+    def poll_services(self) -> int:
+        """Poll every parked ServiceRequest; resume flows whose operation
+        finished. Called from the node's run loop. Returns completions."""
+        if not self._service_queue:
+            return 0
+        done = 0
+        still_pending = []
+        for fsm, poll in self._service_queue:
+            if fsm.state != _WAIT_SERVICE:  # flow died/was restored elsewhere
+                continue
+            try:
+                outcome = poll()
+            except Exception as e:
+                fsm.deliver_service_result(error=e)
+                done += 1
+                continue
+            if outcome is None:
+                still_pending.append((fsm, poll))
+            else:
+                fsm.deliver_service_result(value=outcome)
+                done += 1
+        self._service_queue = still_pending
+        if done:
+            self._pump()
+        return done
 
     def _flush_verify_batch(self) -> None:
         """One batched kernel call covering every parked VerifyTxRequest."""
@@ -939,6 +1033,11 @@ class StateMachineManager:
         self.flows.pop(fsm.run_id, None)
         self.checkpoint_storage.remove_checkpoint(fsm.run_id)
         self.metrics["finished"] += 1
+        # Bounded outcome cache so RPC clients can fetch results after the
+        # flow leaves the registry (the reference returns a future over RPC).
+        self.recent_results[fsm.run_id] = fsm.future
+        while len(self.recent_results) > 1000:
+            self.recent_results.pop(next(iter(self.recent_results)))
         self.changes.append(("remove", fsm.run_id))
         for session in fsm.sessions.values():
             self._sessions_by_local_id.pop(session.local_id, None)

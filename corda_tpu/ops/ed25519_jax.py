@@ -5,20 +5,26 @@ sequential `for (sig in sigs) EdDSAEngine.verify(...)` at reference:
 core/src/main/kotlin/net/corda/core/transactions/SignedTransaction.kt:83-87
 (engine built at core/.../crypto/CryptoUtilities.kt:63-96) — re-designed as a
 data-parallel kernel: N signatures ride the minor axis of every array and the
-whole verification (point decompression, 256-bit double-scalar multiplication,
-canonical re-encoding, byte compare) is one jit-compiled graph with static
-shapes and `lax.scan` loops.
+whole verification (point decompression, 4-bit-windowed 256-bit double-scalar
+multiplication, canonical re-encoding, byte compare) is one jit graph with
+static shapes.
 
 Semantics are bit-identical to the conformance oracle
 (corda_tpu/crypto/ref_ed25519.py — cofactorless ref10 verify, no S<L range
 check, silent y mod p reduction on decompression, encode-compare against the
 raw R bytes). Golden-vector tests enforce the match.
 
+Layout: inputs ship to the device as (8, N) uint32 little-endian words
+(128 B/signature over PCIe/the axon tunnel); limb/window unpacking happens
+on device. The verification core (`verify_core`) is shape-polymorphic in the
+batch dims so the same math runs under plain XLA here and inside the Pallas
+VMEM-resident kernel (corda_tpu/ops/ed25519_pallas.py) on (8, 128) vector
+blocks.
+
 The SHA-512 challenge h = H(R || A || M) mod L is computed on the host
 (hashlib; messages are short and variable-length — a poor fit for fixed-shape
-XLA, and a few microseconds per signature against a millisecond-scale kernel).
-The elliptic-curve math — ~7700 field multiplies per signature — is where the
-time goes, and it is all on-device int32 vector math.
+XLA, and a few microseconds per signature against the millisecond-scale curve
+math, which is ~3,800 field multiplies per signature on device).
 """
 
 from __future__ import annotations
@@ -33,26 +39,18 @@ import jax.numpy as jnp
 from . import fe25519 as fe
 from ..crypto import ref_ed25519 as ref
 
-__all__ = ["verify_batch", "precompute_batch", "verify_arrays", "pick_bucket"]
+__all__ = ["verify_batch", "precompute_batch", "verify_arrays", "pick_bucket",
+           "verify_core"]
 
 _D = ref.D
 _2D = (2 * ref.D) % ref.P
 _SQRT_M1 = pow(2, (ref.P - 1) // 4, ref.P)
 _L = ref.L
 
-# Base point in extended coordinates as (20, 1) broadcastable constants.
-_BX, _BY = ref.B
 
-
-def _c(x: int):
-    return jnp.asarray(fe.limbs_of_int(x % ref.P), fe.I32)[:, None]
-
-
-_B_EXT = (_c(_BX), _c(_BY), _c(1), _c(_BX * _BY % ref.P))
-_K_D = _c(_D)
-_K_2D = _c(_2D)
-_K_SQRT_M1 = _c(_SQRT_M1)
-_ONE = _c(1)
+# Field constants are materialised with fe.fill_limbs (scalar fills) rather
+# than module-level jnp arrays: Pallas kernels cannot close over array
+# constants, and XLA constant-folds the fills to literals anyway.
 
 
 def _ext_add(p, q):
@@ -62,7 +60,7 @@ def _ext_add(p, q):
     x2, y2, z2, t2 = q
     a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
     b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
-    c = fe.mul(fe.mul(t1, t2), jnp.broadcast_to(_K_2D, t1.shape))
+    c = fe.mul(fe.mul(t1, t2), fe.fill_limbs(_2D, t1.shape[1:]))
     d = fe.mul_small(fe.mul(z1, z2), 2)
     e = fe.sub(b, a)
     f = fe.sub(d, c)
@@ -71,51 +69,172 @@ def _ext_add(p, q):
     return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
-def _psel(mask, p, q):
-    return tuple(fe.select(mask, a, b) for a, b in zip(p, q))
+def _ext_dbl(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 8 field muls, complete."""
+    x1, y1, z1, _ = p
+    a = fe.sq(x1)
+    b = fe.sq(y1)
+    c = fe.mul_small(fe.sq(z1), 2)
+    # a_coeff=-1: D = -A; G = D + B = B - A; H = D - B = -(A + B)
+    e = fe.sub(fe.sub(fe.sq(fe.add(x1, y1)), a), b)
+    g = fe.sub(b, a)
+    f = fe.sub(g, c)
+    h = fe.neg(fe.add(a, b))
+    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
-def _double_scalar_mult_sub(s_bits, h_bits, neg_a):
-    """[s]B + [h](-A) via MSB-first Strauss double-and-add in a lax.scan.
+def _masked_sum_entry(table_coords, idx):
+    """Per-lane 16-way table lookup as a static mask-sum (no gather; VPU
+    elementwise only, so it works identically under XLA and Pallas).
+
+    table_coords: tuple of 4 arrays (16, 20, *batch); idx: (*batch,) int32.
+    """
+    out = []
+    for coord in table_coords:
+        acc = coord[0] * (idx == 0).astype(fe.I32)[None]
+        for k in range(1, 16):
+            acc = acc + coord[k] * (idx == k).astype(fe.I32)[None]
+        out.append(acc)
+    return tuple(out)
+
+
+def _build_a_table(neg_a):
+    """[0..15]·(-A) as a tuple of 4 stacked (16, 20, *batch) arrays.
+
+    Entries come from the unified add so every one is a valid extended point
+    (entry 0 = identity)."""
+    x, y, z, t = neg_a
+    batch = x.shape[1:]
+    zero = fe.fill_limbs(0, batch)
+    one = fe.fill_limbs(1, batch)
+    entries = [(zero, one, one, zero), neg_a]
+    for _ in range(14):
+        entries.append(_ext_add(entries[-1], neg_a))
+    return tuple(jnp.stack([e[c] for e in entries]) for c in range(4))
+
+
+# Fixed-base table for B precomputed on host: affine (x, y, t) with z = 1.
+def _host_b_table():
+    entries = []
+    for k in range(16):
+        if k == 0:
+            entries.append((0, 1, 0))
+        else:
+            x, y = ref.scalar_mult(k, ref.B)
+            entries.append((x, y, x * y % ref.P))
+    tab = np.zeros((3, 16, fe.NLIMBS), np.int32)
+    for k, (x, y, t) in enumerate(entries):
+        tab[0, k] = fe.limbs_of_int(x % ref.P)
+        tab[1, k] = fe.limbs_of_int(y % ref.P)
+        tab[2, k] = fe.limbs_of_int(t % ref.P)
+    return tab
+
+
+_B_TABLE = _host_b_table()  # (3, 16, 20) int32; z == 1 for every entry
+
+
+def _b_entry(idx, one, b_table):
+    """B-table lookup: static mask-sum, built limb-by-limb from SCALAR table
+    entries (scalar * (*batch,) mask broadcasts everywhere, including inside
+    Mosaic, which cannot broadcast a (20,) vector along new minor dims).
+    b_table indexes like a (3, 16, 20) array — a jnp constant on the XLA
+    path, an SMEM ref in the Pallas kernel."""
+    masks = [(idx == k).astype(fe.I32) for k in range(16)]
+    coords = []
+    for c in range(3):
+        rows = []
+        for limb in range(fe.NLIMBS):
+            acc = None
+            for k in range(16):
+                term = b_table[c, k, limb] * masks[k]
+                acc = term if acc is None else acc + term
+            rows.append(acc)
+        coords.append(jnp.stack(rows))
+    return (coords[0], coords[1], one, coords[2])
+
+
+def _double_scalar_mult_sub(s_nibs, h_nibs, neg_a, b_table,
+                            unroll: bool = False):
+    """[s]B + [h](-A) via 4-bit windowed Strauss: 64 windows of (4 doublings
+    + 2 table adds) — ~2x fewer field multiplies than bit-serial.
 
     s may be a full 256-bit integer (no range check — oracle semantics).
+    s_nibs/h_nibs: (64, *batch) int32 windows, MSB first.
+    unroll: trace the 64 windows inline (Pallas) instead of lax.scan (XLA).
     """
-    batch = s_bits.shape[1:]
-    acc0 = tuple(jnp.broadcast_to(c, (fe.NLIMBS,) + batch)
-                 for c in (_c(0), _ONE, _ONE, _c(0)))
-    b_ext = tuple(jnp.broadcast_to(c, (fe.NLIMBS,) + batch) for c in _B_EXT)
+    batch = s_nibs.shape[1:]
+    a_table = _build_a_table(neg_a)
+    one = fe.fill_limbs(1, batch)
+    zero = fe.fill_limbs(0, batch)
+    acc0 = (zero, one, one, zero)
 
-    def step(acc, bits):
-        sb, hb = bits
-        acc = _ext_add(acc, acc)
-        acc = _psel(sb > 0, _ext_add(acc, b_ext), acc)
-        acc = _psel(hb > 0, _ext_add(acc, neg_a), acc)
-        return acc, None
+    def window(acc, s_nib, h_nib):
+        for _ in range(4):
+            acc = _ext_dbl(acc)
+        acc = _ext_add(acc, _b_entry(s_nib, one, b_table))
+        acc = _ext_add(acc, _masked_sum_entry(a_table, h_nib))
+        return acc
 
-    xs = jnp.stack([s_bits, h_bits], axis=1)  # (256, 2, *batch)
+    if unroll:
+        acc = acc0
+        for t in range(64):
+            acc = window(acc, s_nibs[t], h_nibs[t])
+        return acc
+
+    def step(acc, nibs):
+        return window(acc, nibs[0], nibs[1]), None
+
+    xs = jnp.stack([s_nibs, h_nibs], axis=1)  # (64, 2, *batch)
     acc, _ = jax.lax.scan(step, acc0, xs)
     return acc
 
 
-@jax.jit
-def verify_arrays(a_limbs, a_sign, r_limbs, r_sign, s_bits, h_bits):
-    """The whole-batch verification graph.
+# ---------------------------------------------------------------------------
+# Device-side unpacking of 32-byte encodings shipped as (8, N) uint32 words.
+# Host→device traffic is 8 words per value instead of 256 unpacked int32
+# bits / 20 limbs — host packing cost and PCIe/tunnel bytes drop ~18x, and
+# the shift/mask unpack fuses into the head of the verify graph.
+# ---------------------------------------------------------------------------
 
-    Args (all int32, batch minor):
-      a_limbs (20, N): low 255 bits of the A encoding (y, possibly >= p)
-      a_sign  (N,):    bit 255 of A
-      r_limbs (20, N): low 255 bits of the R encoding — raw, NOT reduced
-      r_sign  (N,):    bit 255 of R
-      s_bits  (256, N) / h_bits (256, N): scalars, MSB first
-    Returns bool (N,): accept/reject per signature.
+def _unpack_limbs(words):
+    """(8, *batch) uint32 LE words -> ((20, *batch) int32 limbs of bits
+    0..254, (*batch,) int32 sign bit 255).
+
+    Static per-limb loop (Python ints for indices/shifts) — no captured
+    index-array constants, so the same code lowers inside Pallas kernels.
     """
-    one = jnp.broadcast_to(_ONE, a_limbs.shape)
+    limbs = []
+    for i in range(fe.NLIMBS):
+        word, shift = (13 * i) // 32, (13 * i) % 32
+        lo = words[word] >> jnp.uint32(shift)
+        if shift > 19:  # 13 bits spill into the next word
+            hi = (words[word + 1] << jnp.uint32(32 - shift)
+                  if word + 1 < 8 else jnp.zeros_like(lo))
+            lo = lo | hi
+        mask = 0xFF if i == fe.NLIMBS - 1 else fe.MASK  # drop bits >= 255
+        limbs.append(lo & jnp.uint32(mask))
+    sign = (words[7] >> jnp.uint32(31)).astype(jnp.int32)
+    return jnp.stack(limbs).astype(fe.I32), sign
 
-    # --- decompress A (ref10 ge_frombytes semantics) ---
-    y = a_limbs
+
+def _nibbles_msb(words):
+    """(8, *batch) uint32 LE words -> (64, *batch) int32 4-bit windows,
+    MSB first. Static per-window loop (Pallas-compatible, as above)."""
+    nibs = []
+    for j in range(64):
+        bit = 255 - 4 * j - 3
+        word, shift = bit // 32, bit % 32
+        nibs.append((words[word] >> jnp.uint32(shift)) & jnp.uint32(0xF))
+    return jnp.stack(nibs).astype(jnp.int32)
+
+
+def decompress_neg_a(y, a_sign):
+    """ref10 ge_frombytes + negate: (point_ok (*batch,), -A extended)."""
+    batch = y.shape[1:]
+    one = fe.fill_limbs(1, batch)
     yy = fe.sq(y)
     u = fe.sub(yy, one)
-    v = fe.add(fe.mul(yy, jnp.broadcast_to(_K_D, yy.shape)), one)
+    v = fe.add(fe.mul(yy, fe.fill_limbs(_D, batch)), one)
     v3 = fe.mul(fe.sq(v), v)
     v7 = fe.mul(fe.sq(v3), v)
     x = fe.mul(fe.mul(u, v3), fe.pow_p58(fe.mul(u, v7)))
@@ -123,17 +242,17 @@ def verify_arrays(a_limbs, a_sign, r_limbs, r_sign, s_bits, h_bits):
     ok_direct = fe.eq(vxx, u)
     ok_flip = fe.eq(vxx, fe.neg(u))
     x = fe.select(ok_flip & ~ok_direct,
-                  fe.mul(x, jnp.broadcast_to(_K_SQRT_M1, x.shape)), x)
+                  fe.mul(x, fe.fill_limbs(_SQRT_M1, batch)), x)
     point_ok = ok_direct | ok_flip
     parity = fe.freeze(x)[0] & 1
     x = fe.select(parity != a_sign, fe.neg(x), x)
-
-    # --- R' = [s]B - [h]A ---
     nx = fe.neg(x)
-    neg_a = (nx, y, one, fe.mul(nx, y))
-    rx, ry, rz, _ = _double_scalar_mult_sub(s_bits, h_bits, neg_a)
+    return point_ok, (nx, y, one, fe.mul(nx, y))
 
-    # --- canonical encode R' and compare with the raw R bytes ---
+
+def encode_compare(rpoint, r_limbs, r_sign, point_ok):
+    """Canonical-encode R' and compare against the raw R bytes."""
+    rx, ry, rz, _ = rpoint
     zi = fe.inv(rz)
     xr = fe.freeze(fe.mul(rx, zi))
     yr = fe.freeze(fe.mul(ry, zi))
@@ -141,7 +260,40 @@ def verify_arrays(a_limbs, a_sign, r_limbs, r_sign, s_bits, h_bits):
     return point_ok & enc_ok
 
 
-def pick_bucket(n: int, buckets=(64, 256, 1024, 4096, 16384)) -> int:
+def verify_core(y, a_sign, r_limbs, r_sign, s_nibs, h_nibs,
+                b_table=None, unroll: bool = False):
+    """The verification math on unpacked values; shape-polymorphic in the
+    batch dims (XLA path: batch = (N,); Pallas path: batch = (8, 128)).
+
+    y/(r_limbs): (20, *batch) canonical limbs; signs (*batch,);
+    nibs (64, *batch); b_table (3, 16, 20) (defaults to the module constant —
+    Pallas passes it as a kernel input). Returns bool (*batch,).
+    """
+    if b_table is None:
+        b_table = jnp.asarray(_B_TABLE)
+    point_ok, neg_a = decompress_neg_a(y, a_sign)
+    rpoint = _double_scalar_mult_sub(s_nibs, h_nibs, neg_a, b_table, unroll)
+    return encode_compare(rpoint, r_limbs, r_sign, point_ok)
+
+
+@jax.jit
+def verify_arrays(a_words, r_words, s_words, h_words):
+    """The whole-batch verification graph (plain XLA path).
+
+    Args (all (8, N) uint32, little-endian words, batch minor):
+      a_words: the 32-byte A (public key) encodings
+      r_words: the 32-byte R encodings — raw, NOT reduced
+      s_words: the S scalars (no range check — oracle semantics)
+      h_words: SHA-512(R||A||M) mod L, computed on host
+    Returns bool (N,): accept/reject per signature.
+    """
+    y, a_sign = _unpack_limbs(a_words)
+    r_limbs, r_sign = _unpack_limbs(r_words)
+    return verify_core(y, a_sign, r_limbs, r_sign,
+                       _nibbles_msb(s_words), _nibbles_msb(h_words))
+
+
+def pick_bucket(n: int, buckets=(64, 256, 1024, 4096, 16384, 65536)) -> int:
     """Static batch-size bucket: jit caches one executable per bucket instead
     of recompiling per request size (p99 protection on the notary path)."""
     for b in buckets:
@@ -150,31 +302,77 @@ def pick_bucket(n: int, buckets=(64, 256, 1024, 4096, 16384)) -> int:
     return -(-n // buckets[-1]) * buckets[-1]
 
 
+def _words_of(enc: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 little-endian encodings -> (8, B) uint32 words."""
+    return np.ascontiguousarray(enc).view("<u4").T.copy()
+
+
 def precompute_batch(pubkeys, msgs, sigs, bucket: int | None = None):
-    """Host-side packing: 32-byte keys + messages + 64-byte sigs -> kernel arrays.
+    """Host-side packing: 32-byte keys + messages + 64-byte sigs -> four
+    (8, bucket) uint32 word arrays (A, R, S, h) for verify_arrays.
 
     Computes h = SHA-512(R_enc || A_enc || M) mod L with the ORIGINAL encodings
-    (ref10: the pk bytes go straight into the hash) and pads to the bucket size.
+    (ref10: the pk bytes go straight into the hash) and pads to the bucket
+    size. All bit/limb unpacking happens on device.
     """
     n = len(sigs)
     b = bucket or pick_bucket(n)
+    # Bulk byte concatenation + one frombuffer per array: ~10x faster than
+    # per-row numpy assignment at notary batch sizes.
+    pk_cat = b"".join(bytes(k) for k in pubkeys)
+    sig_cat = b"".join(bytes(s) for s in sigs)
     pk = np.zeros((b, 32), np.uint8)
     r_enc = np.zeros((b, 32), np.uint8)
     s_raw = np.zeros((b, 32), np.uint8)
     h_raw = np.zeros((b, 32), np.uint8)
+    pk[:n] = np.frombuffer(pk_cat, np.uint8).reshape(n, 32)
+    sg = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
+    r_enc[:n] = sg[:, :32]
+    s_raw[:n] = sg[:, 32:]
+    sha512 = hashlib.sha512
+    h_rows = h_raw[:n]
     for i in range(n):
-        pk[i] = np.frombuffer(bytes(pubkeys[i]), np.uint8)
-        sig = bytes(sigs[i])
-        r_enc[i] = np.frombuffer(sig[:32], np.uint8)
-        s_raw[i] = np.frombuffer(sig[32:64], np.uint8)
-        h = int.from_bytes(
-            hashlib.sha512(sig[:32] + bytes(pubkeys[i]) + bytes(msgs[i])).digest(),
-            "little") % _L
-        h_raw[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
-    a_limbs, a_sign = fe.pack_le_bytes(pk)
-    r_limbs, r_sign = fe.pack_le_bytes(r_enc)
-    return (a_limbs, a_sign, r_limbs, r_sign,
-            fe.scalar_bits_msb(s_raw), fe.scalar_bits_msb(h_raw)), n
+        digest = sha512(sig_cat[64 * i:64 * i + 32]
+                        + pk_cat[32 * i:32 * i + 32]
+                        + bytes(msgs[i])).digest()
+        h = int.from_bytes(digest, "little") % _L
+        h_rows[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    return (_words_of(pk), _words_of(r_enc),
+            _words_of(s_raw), _words_of(h_raw)), n
+
+
+_PALLAS_STATE = {"available": None}
+
+
+def _pallas_available() -> bool:
+    """The Mosaic kernel needs a real TPU backend (CPU runs the XLA graph);
+    CORDA_TPU_NO_PALLAS=1 forces the XLA path for A/B comparison."""
+    import os
+
+    if os.environ.get("CORDA_TPU_NO_PALLAS"):
+        return False
+    if _PALLAS_STATE["available"] is None:
+        try:
+            _PALLAS_STATE["available"] = jax.devices()[0].platform != "cpu"
+        except Exception:
+            _PALLAS_STATE["available"] = False
+    return _PALLAS_STATE["available"]
+
+
+def verify_arrays_auto(a_words, r_words, s_words, h_words):
+    """Best available backend for the word-array contract: the VMEM-resident
+    Pallas kernel on TPU (batch must be a multiple of 1024), the plain XLA
+    graph otherwise. Falls back to XLA if the Mosaic compile fails."""
+    n = a_words.shape[1]
+    if _pallas_available() and n % 1024 == 0:
+        from . import ed25519_pallas
+
+        try:
+            return ed25519_pallas.verify_arrays_pallas(
+                a_words, r_words, s_words, h_words)
+        except Exception:  # Mosaic regression: stay correct on the XLA path
+            _PALLAS_STATE["available"] = False
+    return verify_arrays(a_words, r_words, s_words, h_words)
 
 
 def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
@@ -190,10 +388,13 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
             if len(bytes(pubkeys[i])) == 32 and len(bytes(sigs[i])) == 64]
     if not good:
         return ok_shape
+    bucket = pick_bucket(len(good))
+    if _pallas_available():
+        bucket = max(bucket, 1024)  # Pallas blocks are 1024 lanes
     arrays, _ = precompute_batch([pubkeys[i] for i in good],
                                  [msgs[i] for i in good],
-                                 [sigs[i] for i in good])
-    out = np.asarray(verify_arrays(*arrays))
+                                 [sigs[i] for i in good], bucket=bucket)
+    out = np.asarray(verify_arrays_auto(*arrays))
     for j, i in enumerate(good):
         ok_shape[i] = out[j]
     return ok_shape

@@ -74,20 +74,15 @@ def _carry(x: jnp.ndarray):
     return jnp.stack(out), c
 
 
-def reduce(x: jnp.ndarray) -> jnp.ndarray:
-    """Weak-reduce an (n, *batch) signed limb array (n in [20, 39]) to 20 limbs
-    in [0, 2^13), value congruent mod p, value < 2^260."""
+def normalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact weak reduction: (20, *batch) signed bounded limbs -> limbs in
+    [0, 2^13), value congruent mod p, value < 2^260.
+
+    Uses the sequential carry chain — precise but expensive to compile, so it
+    runs ONLY at comparison/canonicalisation points (freeze/eq/is_zero); the
+    arithmetic interior uses the vectorized lazy `_settle` rounds instead.
+    """
     limbs, c = _carry(x)
-    if x.shape[0] > NLIMBS:
-        # Fold limbs at positions >= 20 (weight 2^(260+13k) === 608*2^13k).
-        # Carry out of an n-limb input has weight 2^(13n): it sits right after
-        # limbs[20:n] in the folded vector, before any zero padding.
-        pad = NCOEF - x.shape[0]
-        high = jnp.concatenate([limbs[NLIMBS:], c[None]])
-        if pad:
-            high = jnp.concatenate([high, jnp.zeros((pad,) + x.shape[1:], I32)])
-        v = limbs[:NLIMBS] + FOLD * high
-        limbs, c = _carry(v)
     # Fold the (possibly negative) carry-out at weight 2^260 twice; the second
     # pass always lands with zero carry (|c| shrinks by ~2^13 per round).
     for _ in range(2):
@@ -96,26 +91,105 @@ def reduce(x: jnp.ndarray) -> jnp.ndarray:
     return limbs
 
 
+def _settle(x: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized lazy-carry round on (20, *batch) signed limbs: split
+    off the 13-bit residue, push carries up one limb, fold the top carry
+    (weight 2^260 === 608) back to limb 0. All elementwise — compiles to a
+    handful of fused ops, no sequential chain.
+
+    Bound: |x| <= M  ->  output in (-609*M/8192, 8192 + 609*M/8192).
+    Fixed point ~8850, so two rounds bring any |x| <= 19000 below the
+    mul-input bound (see `mul`).
+    """
+    hi = x >> RADIX  # arithmetic shift: floor semantics for negatives
+    lo = x & MASK
+    # Static indices only: hi[-1]/hi[:-1] would lower to dynamic_slice,
+    # which Mosaic (Pallas TPU) does not implement.
+    top = x.shape[0] - 1
+    up = jnp.concatenate([(hi[top] * FOLD)[None], hi[0:top]])
+    return lo + up
+
+
+# Lazy-arithmetic contract:
+#   * every op below returns limbs bounded by ~|9500| (usually ~8900);
+#   * `mul` accepts limb magnitudes up to 10000 (20 * 10000^2 < 2^31);
+#   * canonical form exists only after normalize()/freeze().
+
+
 def add(a, b):
-    return reduce(a + b)
+    return _settle(_settle(a + b))
 
 
 def sub(a, b):
-    return reduce(a - b)
+    return _settle(_settle(a - b))
 
 
 def neg(a):
-    return reduce(-a)
+    return _settle(_settle(-a))
+
+
+# Static gather pattern for the 20x20 schoolbook convolution: coefficient k
+# sums O[i, k-i] over valid i.
+_CONV_K = np.arange(NCOEF)[:, None]          # (39, 1)
+_CONV_I = np.arange(NLIMBS)[None, :]         # (1, 20)
+_CONV_J = np.clip(_CONV_K - _CONV_I, 0, NLIMBS - 1)  # (39, 20)
+_CONV_VALID = ((_CONV_K - _CONV_I >= 0) & (_CONV_K - _CONV_I < NLIMBS)
+               ).astype(np.int32)            # (39, 20)
+
+# Two convolution lowerings with identical semantics:
+#   "gather": one outer product + static gather + masked reduce — tiny HLO
+#             graph (compiles fast), at the cost of a (20, 20, *batch)
+#             intermediate the backend must fuse or spill;
+#   "rows":   39 unrolled row sums of elementwise products — large HLO graph
+#             (slow XLA compile) but pure streaming VPU ops.
+# The Pallas kernel (everything in VMEM) uses "rows"; the plain XLA path
+# defaults to "gather".
+CONV_MODE = "gather"
+
+
+def _conv_sum(a, b):
+    if CONV_MODE == "rows":
+        rows = []
+        for k in range(NCOEF):
+            terms = [a[i] * b[k - i]
+                     for i in range(max(0, k - NLIMBS + 1), min(NLIMBS, k + 1))]
+            s = terms[0]
+            for t in terms[1:]:
+                s = s + t
+            rows.append(s)
+        return jnp.stack(rows)
+    outer = a[:, None] * b[None, :]          # (20, 20, *batch)
+    gathered = outer[_CONV_I.ravel()[None, :].repeat(NCOEF, 0), _CONV_J]
+    mask = jnp.asarray(_CONV_VALID).reshape(
+        (NCOEF, NLIMBS) + (1,) * (a.ndim - 1))
+    return jnp.sum(gathered * mask, axis=1)
 
 
 def mul(a, b):
-    """Field multiply. Inputs must be weak-reduced (limbs in [0, 2^13))."""
-    batch = a.shape[1:]
-    acc = jnp.zeros((NCOEF,) + batch, I32)
-    for i in range(NLIMBS):
-        seg = acc[i:i + NLIMBS] + a[i] * b
-        acc = jnp.concatenate([acc[:i], seg, acc[i + NLIMBS:]])
-    return reduce(acc)
+    """Field multiply: limbs |.| <= 10000 in, limbs in (-1500, 8900) out.
+
+    Schoolbook convolution (see _conv_sum) followed by vectorized carry
+    rounds — no sequential carry chain, no scatter.
+    """
+    acc = _conv_sum(a, b)                     # (39, *batch), |.| < 2^31
+    # Two carry rounds over the 41 coefficient positions (carries out of the
+    # top ride along), bringing every position under ~2^13.01 ...
+    ext = jnp.concatenate(
+        [acc, jnp.zeros((2,) + acc.shape[1:], I32)])  # (41, *batch)
+    for _ in range(2):
+        hi = ext >> RADIX
+        ext = (ext & MASK) + jnp.concatenate(
+            [jnp.zeros((1,) + hi.shape[1:], I32), hi[0:ext.shape[0] - 1]])
+    # ... then fold positions 20..40 down (2^(260+13k) === 608 * 2^13k;
+    # position 40 === 608^2 at position 0) and settle.
+    v = ext[:NLIMBS] + FOLD * ext[NLIMBS:2 * NLIMBS]
+    top = jnp.concatenate(
+        [(FOLD * FOLD * ext[2 * NLIMBS])[None],
+         jnp.zeros((NLIMBS - 1,) + v.shape[1:], I32)])
+    v = v + top
+    for _ in range(5):
+        v = _settle(v)
+    return v
 
 
 def sq(a):
@@ -123,24 +197,37 @@ def sq(a):
 
 
 def mul_small(a, k: int):
-    """Multiply by a small host constant k (k*2^13*20 must fit int32)."""
-    return reduce(a * np.int32(k))
+    """Multiply by a small host constant k (|k| <= 16: k * 9500 * 609/8192
+    settles below the mul bound in three rounds)."""
+    v = a * np.int32(k)
+    for _ in range(3):
+        v = _settle(v)
+    return v
 
 
 def _pow_bits(x, exponent: int):
-    """x^exponent via MSB-first square-and-multiply inside a lax.scan
-    (keeps the XLA graph ~2 muls instead of ~2*255 unrolled)."""
-    bits = [int(b) for b in bin(exponent)[2:]]
-    bits_arr = jnp.asarray(bits[1:], I32)  # leading 1 -> start acc = x
+    """x^exponent via MSB-first square-and-multiply in a fori_loop (keeps
+    the graph ~2 muls instead of ~2*255 unrolled; fori rather than scan so
+    the same code lowers inside Pallas kernels).
 
-    def step(acc, bit):
+    The bit at each step is computed from the loop index by comparing against
+    the exponent's zero positions — scalar arithmetic only, no captured bit
+    array (Pallas kernels cannot close over array constants). Efficient for
+    the near-all-ones exponents used here (p-2 has two zero bits, (p-5)/8
+    has one).
+    """
+    bits = [int(b) for b in bin(exponent)[2:]][1:]  # leading 1 -> acc = x
+    zero_positions = [i for i, b in enumerate(bits) if b == 0]
+
+    def step(i, acc):
         acc = mul(acc, acc)
         withx = mul(acc, x)
-        acc = jnp.where(bit > 0, withx, acc)
-        return acc, None
+        bit = jnp.bool_(True)
+        for z in zero_positions:
+            bit = bit & (i != z)
+        return jnp.where(bit, withx, acc)
 
-    acc, _ = jax.lax.scan(step, x, bits_arr)
-    return acc
+    return jax.lax.fori_loop(0, len(bits), step, x)
 
 
 def inv(a):
@@ -153,21 +240,29 @@ def pow_p58(a):
     return _pow_bits(a, (P - 5) // 8)
 
 
-# Precomputed k*p limb constants for the freeze ladder (k*p < 2^260 for k<=32).
-_KP = {k: jnp.asarray(limbs_of_int(k * P), I32) for k in (32, 16, 8, 4, 2, 1)}
+def fill_limbs(value: int, batch_shape) -> jnp.ndarray:
+    """(20, *batch) constant built from scalar fills — usable inside Pallas
+    kernels, which cannot close over array constants; XLA constant-folds it
+    to the same thing as a literal array."""
+    host = limbs_of_int(value % (1 << (RADIX * NLIMBS)))
+    return jnp.stack([jnp.full(tuple(batch_shape), int(l), I32) for l in host])
+
+
+# k*p limb values for the freeze ladder (k*p < 2^260 for k <= 32).
+_KP_INT = {k: k * P for k in (32, 16, 8, 4, 2, 1)}
 
 
 def freeze(a):
-    """Canonical representative in [0, p) of a weak-reduced element.
+    """Canonical representative in [0, p) of a (possibly lazy) element.
 
-    Binary ladder of conditional subtractions: value < 2^260 < 64p, so
-    subtracting k*p for k = 32,16,...,1 whenever value >= k*p lands in [0,p).
+    Normalizes to exact weak-reduced form first, then a binary ladder of
+    conditional subtractions: value < 2^260 < 64p, so subtracting k*p for
+    k = 32,16,...,1 whenever value >= k*p lands in [0,p).
     """
-    v = a
-    batch_nd = a.ndim - 1
+    v = normalize(a)
+    batch = a.shape[1:]
     for k in (32, 16, 8, 4, 2, 1):
-        kp = _KP[k].reshape((NLIMBS,) + (1,) * batch_nd)
-        d, c = _carry(v - kp)
+        d, c = _carry(v - fill_limbs(_KP_INT[k], batch))
         v = jnp.where((c < 0)[None], v, d)
     return v
 

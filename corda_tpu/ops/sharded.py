@@ -51,11 +51,8 @@ def pad_to_devices(n: int, n_devices: int) -> int:
     return -(-max(n, 1) // n_devices) * n_devices
 
 
-# Kernel array layout: limbs/bits are batch-minor, signs are 1-D.
-#   a_limbs (20,N)  a_sign (N,)  r_limbs (20,N)  r_sign (N,)
-#   s_bits (256,N)  h_bits (256,N)   ->  ok (N,)
-_IN_SPECS = (P(None, BATCH_AXIS), P(BATCH_AXIS), P(None, BATCH_AXIS),
-             P(BATCH_AXIS), P(None, BATCH_AXIS), P(None, BATCH_AXIS))
+# Kernel array layout: four (8, N) uint32 word arrays, batch minor.
+_IN_SPECS = (P(None, BATCH_AXIS),) * 4
 _OUT_SPEC = P(BATCH_AXIS)
 
 

@@ -1,0 +1,214 @@
+"""Loadtest + notary-demo driver: firehose a notary (cluster) and disrupt it.
+
+Capability match for the reference's load/chaos tooling and demo driver
+(reference: tools/loadtest/src/main/kotlin/net/corda/loadtest/LoadTest.kt:
+39-144 — generate/execute/gather loop with convergence checking;
+Disruption.kt:18-60 — node kill/restart fault injection; and
+samples/raft-notary-demo/src/main/kotlin/net/corda/notarydemo/NotaryDemo.kt:
+14-29 — the issue+move firehose through NotaryFlow.Client).
+
+Everything runs in one process over real TCP sockets + sqlite nodes (the
+reference drives remote JVMs over SSH; the in-process form keeps the same
+measurement semantics — real transport, real persistence, real consensus —
+without a cluster). Disruptions kill a node mid-run and rebuild it purely
+from its base_dir.
+
+CLI:
+  python -m corda_tpu.tools.loadtest --tx 200 --notary simple
+  python -m corda_tpu.tools.loadtest --tx 200 --notary raft --disrupt kill-follower
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..flows.notary import NotaryClientFlow
+from ..node.config import BatchConfig, NodeConfig
+from ..node.node import Node
+from ..testing.dummies import DummyContract
+
+
+@dataclass
+class LoadTestResult:
+    tx_requested: int
+    tx_committed: int
+    tx_rejected: int
+    duration_s: float
+    tx_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    sigs_verified: int
+    verify_batches: int
+    disruptions: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+def _make_node(base: Path, name: str, **kw) -> Node:
+    return Node(NodeConfig(
+        name=name, base_dir=base / name, network_map=base / "netmap.json",
+        **kw)).start()
+
+
+def _rebuild(config: NodeConfig) -> Node:
+    return Node(NodeConfig(
+        name=config.name, base_dir=config.base_dir, notary=config.notary,
+        raft_cluster=config.raft_cluster, network_map=config.network_map,
+        batch=config.batch, verifier=config.verifier)).start()
+
+
+def run_loadtest(
+    n_tx: int = 100,
+    notary: str = "simple",  # simple | validating | raft
+    cluster_size: int = 3,
+    disrupt: str | None = None,  # kill-notary | kill-follower | None
+    verifier: str = "cpu",
+    batch: BatchConfig | None = None,
+    base_dir: str | None = None,
+    max_seconds: float = 120.0,
+) -> LoadTestResult:
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-load-"))
+    batch = batch or BatchConfig()
+    notaries: list[Node] = []
+    disruptions: list[str] = []
+
+    if notary == "raft":
+        cluster = tuple(f"Raft{i}" for i in range(cluster_size))
+        for name in cluster:
+            notaries.append(_make_node(
+                base, name, notary="raft-simple", raft_cluster=cluster,
+                verifier=verifier, batch=batch))
+    else:
+        notaries.append(_make_node(base, "Notary", notary=notary,
+                                   verifier=verifier, batch=batch))
+    client = _make_node(base, "LoadClient", verifier=verifier, batch=batch)
+    nodes = notaries + [client]
+    for n in nodes:
+        n.refresh_netmap()
+
+    if notary == "raft":  # wait for a leader before the firehose
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            for n in nodes:
+                n.run_once(timeout=0.005)
+            if any(n.raft_member.role == "leader" for n in notaries):
+                break
+        else:
+            raise RuntimeError("raft cluster failed to elect")
+
+    target = notaries[0].identity
+    # The firehose workload: issue (local) + move (notarised) per tx —
+    # the raft-notary-demo shape (NotaryDemoApi issue+move).
+    stxs = []
+    for i in range(n_tx):
+        builder = DummyContract.generate_initial(
+            client.identity.ref(i.to_bytes(4, "big")), i, target)
+        builder.sign_with(client.key)
+        issue_stx = builder.to_signed_transaction()
+        client.services.record_transactions([issue_stx])
+        move = DummyContract.move(issue_stx.tx.out_ref(0),
+                                  client.identity.owning_key)
+        move.sign_with(client.key)
+        stxs.append(move.to_signed_transaction(
+            check_sufficient_signatures=False))
+
+    t0 = time.perf_counter()
+    done_at: list[float] = []
+    handles = []
+    for stx in stxs:
+        h = client.start_flow(NotaryClientFlow(stx))
+        h.result.add_done_callback(
+            lambda _f: done_at.append(time.perf_counter() - t0))
+        handles.append(h)
+
+    disrupted = False
+    deadline = time.monotonic() + max_seconds
+    while time.monotonic() < deadline:
+        for n in nodes:
+            n.run_once(timeout=0.002)
+        completed = sum(1 for h in handles if h.result.done)
+        if not disrupted and disrupt and completed >= n_tx // 3:
+            disrupted = True
+            if disrupt == "kill-notary" or notary != "raft":
+                victim = notaries[0]
+            else:  # kill-follower: keep quorum; don't kill the leader
+                victim = next(
+                    (n for n in notaries if n.raft_member.role != "leader"),
+                    notaries[-1])
+            cfg = victim.config
+            victim.stop()
+            nodes.remove(victim)
+            notaries.remove(victim)
+            disruptions.append(f"killed {cfg.name} after {completed} tx")
+            reborn = _rebuild(cfg)
+            notaries.append(reborn)
+            nodes.append(reborn)
+            for n in nodes:
+                n.refresh_netmap()
+            disruptions.append(f"rebuilt {cfg.name} from disk")
+        if completed == n_tx:
+            break
+    duration = time.perf_counter() - t0
+
+    committed = rejected = 0
+    for h in handles:
+        if not h.result.done:
+            continue
+        if h.result.exception() is None:
+            committed += 1
+        else:
+            rejected += 1
+    lat = sorted(done_at) or [0.0]
+    metrics = client.smm.metrics
+    notary_metrics = [n.smm.metrics for n in notaries]
+    result = LoadTestResult(
+        tx_requested=n_tx,
+        tx_committed=committed,
+        tx_rejected=rejected,
+        duration_s=round(duration, 3),
+        tx_per_sec=round(len(done_at) / duration, 1) if done_at else 0.0,
+        p50_ms=round(1e3 * lat[len(lat) // 2], 2),
+        p99_ms=round(1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+        sigs_verified=metrics["verify_sigs"]
+        + sum(m["verify_sigs"] for m in notary_metrics),
+        verify_batches=metrics["verify_batches"]
+        + sum(m["verify_batches"] for m in notary_metrics),
+        disruptions=disruptions,
+    )
+    for n in nodes:
+        n.stop()
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tx", type=int, default=100)
+    ap.add_argument("--notary", choices=("simple", "validating", "raft"),
+                    default="simple")
+    ap.add_argument("--cluster-size", type=int, default=3)
+    ap.add_argument("--disrupt", choices=("kill-notary", "kill-follower"),
+                    default=None)
+    ap.add_argument("--verifier", choices=("cpu", "jax", "jax-shadow"),
+                    default="cpu")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-sigs", type=int, default=4096)
+    args = ap.parse_args(argv)
+    result = run_loadtest(
+        n_tx=args.tx, notary=args.notary, cluster_size=args.cluster_size,
+        disrupt=args.disrupt, verifier=args.verifier,
+        batch=BatchConfig(max_sigs=args.max_sigs,
+                          max_wait_ms=args.max_wait_ms))
+    print(result.to_json())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -44,19 +44,26 @@ def test_binary_ops(op, pyop):
     bvals = rand_ints(len(EDGE)) + EDGE + rand_ints(8)
     a, b = batch_of(avals), batch_of(bvals[: len(avals)])
     got = as_ints(op(a, b))
+    arr = np.asarray(op(a, b))
+    # Lazy contract: congruent mod p, limbs within the mul-input bound.
+    assert abs(arr).max() <= 10_000
     for g, x, y in zip(got, avals, bvals):
         assert g % P == pyop(x, y), (x, y)
-        assert 0 <= g < 1 << 260
 
 
-def test_mul_inputs_must_be_weak_reduced_contract():
-    # mul requires limbs in [0, 2^13); reduce() establishes that.
-    vals = rand_ints(8)
-    a = fe.reduce(batch_of(vals) * 1)  # already canonical limbs
-    assert np.asarray(a).max() < 1 << 13
+def test_lazy_ops_compose_within_mul_bound():
+    # add/sub/mul outputs must be directly usable as mul inputs: chain a few
+    # and compare against big-int ground truth.
+    vals = rand_ints(6)
+    a, b = batch_of(vals[:3]), batch_of(vals[3:])
+    out = fe.mul(fe.add(a, b), fe.sub(a, b))          # (a+b)(a-b)
+    out = fe.mul(out, fe.mul_small(fe.neg(a), 2))      # * (-2a)
+    got = as_ints(fe.freeze(out))
+    for g, x, y in zip(got, vals[:3], vals[3:]):
+        assert g == (x + y) * (x - y) * (-2 * x) % P
 
 
-def test_neg_and_reduce_signed():
+def test_neg_signed():
     vals = EDGE + rand_ints(10)
     a = batch_of(vals)
     got = as_ints(fe.neg(a))
@@ -117,18 +124,16 @@ def test_scalar_bits_msb():
         assert got == n
 
 
-def test_reduce_midrange_limb_counts():
-    # Regression: the carry out of an n-limb input (20 < n < 39) has weight
-    # 2^(13n) and must fold at that position, not at 2^507.
-    import numpy as np
-
-    rng = np.random.default_rng(7)
-    for n in (21, 25, 30, 38, 39):
-        raw = rng.integers(0, 1 << 30, size=(n, 3), dtype=np.int64).astype(np.int32)
-        want = [
-            sum(int(raw[i, j]) << (fe.RADIX * i) for i in range(n)) % fe.P
-            for j in range(3)
-        ]
-        got = fe.reduce(jnp.asarray(raw))
-        for j in range(3):
-            assert fe.int_of_limbs(np.asarray(got)[:, j]) % fe.P == want[j], n
+def test_normalize_exact_weak_reduction():
+    # normalize must take lazy (signed, out-of-range) limbs to canonical
+    # [0, 2^13) limbs with value < 2^260, preserving the residue.
+    rng2 = np.random.default_rng(7)
+    raw = rng2.integers(-10_000, 10_000, size=(20, 5), dtype=np.int64).astype(np.int32)
+    want = [
+        sum(int(raw[i, j]) << (fe.RADIX * i) for i in range(20)) % fe.P
+        for j in range(5)
+    ]
+    got = np.asarray(fe.normalize(jnp.asarray(raw)))
+    assert got.min() >= 0 and got.max() < 1 << fe.RADIX
+    for j in range(5):
+        assert fe.int_of_limbs(got[:, j]) % fe.P == want[j]

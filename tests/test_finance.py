@@ -1,0 +1,257 @@
+"""Cash contract rules + TwoPartyTradeFlow DvP end-to-end.
+
+Mirrors the reference's CashTests (reference: finance/src/test/kotlin/net/
+corda/contracts/asset/CashTests.kt) at the unit tier and
+TwoPartyTradeProtocolTests at the MockNetwork tier. Makes BASELINE configs
+2 and 4 (trades via validating notary; multi-sig cash) runnable.
+"""
+
+import pytest
+
+from corda_tpu.contracts.dsl import RequirementFailed
+from corda_tpu.contracts.structures import Command, Issued
+from corda_tpu.contracts.verification import ContractRejection
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.party import Party
+from corda_tpu.finance import Amount, Cash, CashExit, CashIssue, CashMove, CashState
+from corda_tpu.finance.cash import InsufficientBalanceException
+from corda_tpu.flows.notary import NotaryClientFlow
+from corda_tpu.testing.mock_network import MockNetwork
+from corda_tpu.transactions.builder import TransactionBuilder
+
+
+MEGA_KEY = KeyPair.generate(b"\x31" * 32)
+MEGA_CORP = Party.of("MegaCorp", MEGA_KEY.public)
+ALICE_KEY = KeyPair.generate(b"\x32" * 32)
+ALICE = Party.of("Alice", ALICE_KEY.public)
+BOB_KEY = KeyPair.generate(b"\x33" * 32)
+BOB = Party.of("Bob", BOB_KEY.public)
+NOTARY_KEY = KeyPair.generate(b"\x34" * 32)
+NOTARY = Party.of("Notary", NOTARY_KEY.public)
+
+USD = "USD"
+
+
+def issued_usd(qty):
+    return Amount(qty, Issued(MEGA_CORP.ref(b"\x01"), USD))
+
+
+def issue_tx(qty=1000, owner=None, sign=True):
+    tx = Cash.generate_issue(
+        Amount(qty, USD), MEGA_CORP.ref(b"\x01"),
+        owner or ALICE.owning_key, NOTARY, nonce=7)
+    if sign:
+        tx.sign_with(MEGA_KEY)
+    return tx
+
+
+class FakeStorage:
+    def __init__(self, txs):
+        self._txs = {t.id: t for t in txs}
+
+    def get_transaction(self, id):
+        return self._txs.get(id)
+
+
+class FakeServices:
+    """Just enough ServiceHub for to_ledger_transaction in unit tests."""
+
+    def __init__(self, txs=(), parties=()):
+        from types import SimpleNamespace
+
+        self.storage_service = SimpleNamespace(
+            validated_transactions=FakeStorage(txs),
+            attachments=SimpleNamespace(open_attachment=lambda _id: None),
+        )
+        self._parties = {p.owning_key: p for p in parties}
+        self.identity_service = SimpleNamespace(
+            party_from_key=lambda k: self._parties.get(k))
+
+    def load_state(self, ref):
+        stx = self.storage_service.validated_transactions.get_transaction(
+            ref.txhash)
+        return None if stx is None else stx.tx.outputs[ref.index]
+
+
+class TestCashRules:
+    def test_issue_ok(self):
+        stx = issue_tx().to_signed_transaction()
+        ltx = stx.tx.to_ledger_transaction(FakeServices())
+        ltx.verify()  # issuer signed, outputs > inputs
+
+    def test_issue_without_issuer_signature_rejected(self):
+        tx = TransactionBuilder(notary=NOTARY)
+        tx.add_output_state(CashState(issued_usd(500), ALICE.owning_key))
+        tx.add_command(Command(CashIssue(1), (ALICE.owning_key,)))  # not issuer
+        wtx = tx.to_wire_transaction()
+        with pytest.raises(ContractRejection, match="issuer"):
+            wtx.to_ledger_transaction(FakeServices()).verify()
+
+    def test_move_conserves_value(self):
+        issue_stx = issue_tx().to_signed_transaction()
+        prior = issue_stx.tx.out_ref(0)
+        tx = TransactionBuilder(notary=NOTARY)
+        tx.add_input_state(prior)
+        tx.add_output_state(CashState(issued_usd(400), BOB.owning_key))
+        tx.add_output_state(CashState(issued_usd(600), ALICE.owning_key))
+        tx.add_command(Command(CashMove(), (ALICE.owning_key,)))
+        wtx = tx.to_wire_transaction()
+        wtx.to_ledger_transaction(FakeServices([issue_stx])).verify()
+
+    def test_move_that_creates_money_rejected(self):
+        issue_stx = issue_tx().to_signed_transaction()
+        prior = issue_stx.tx.out_ref(0)
+        tx = TransactionBuilder(notary=NOTARY)
+        tx.add_input_state(prior)
+        tx.add_output_state(CashState(issued_usd(1001), BOB.owning_key))
+        tx.add_command(Command(CashMove(), (ALICE.owning_key,)))
+        with pytest.raises(ContractRejection, match="amounts balance"):
+            tx.to_wire_transaction().to_ledger_transaction(
+                FakeServices([issue_stx])).verify()
+
+    def test_move_without_owner_signature_rejected(self):
+        issue_stx = issue_tx().to_signed_transaction()
+        prior = issue_stx.tx.out_ref(0)
+        tx = TransactionBuilder(notary=NOTARY)
+        tx.add_input_state(prior)
+        tx.add_output_state(CashState(issued_usd(1000), BOB.owning_key))
+        tx.add_command(Command(CashMove(), (BOB.owning_key,)))  # wrong signer
+        with pytest.raises(ContractRejection, match="owner has signed"):
+            tx.to_wire_transaction().to_ledger_transaction(
+                FakeServices([issue_stx])).verify()
+
+    def test_exit_burns_exact_amount(self):
+        issue_stx = issue_tx().to_signed_transaction()
+        prior = issue_stx.tx.out_ref(0)
+        tx = TransactionBuilder(notary=NOTARY)
+        Cash.generate_exit(tx, issued_usd(250), [prior])
+        wtx = tx.to_wire_transaction()
+        wtx.to_ledger_transaction(FakeServices([issue_stx])).verify()
+        remaining = [o.data for o in wtx.outputs]
+        assert len(remaining) == 1 and remaining[0].amount.quantity == 750
+
+    def test_different_issuers_do_not_mix(self):
+        other_issuer = Issued(ALICE.ref(b"\x02"), USD)
+        issue_stx = issue_tx().to_signed_transaction()
+        prior = issue_stx.tx.out_ref(0)
+        tx = TransactionBuilder(notary=NOTARY)
+        tx.add_input_state(prior)
+        # Output claims a different issuer: that group has no inputs and no
+        # issue command -> rejected; the input group loses value -> rejected.
+        tx.add_output_state(CashState(Amount(1000, other_issuer), BOB.owning_key))
+        tx.add_command(Command(CashMove(), (ALICE.owning_key,)))
+        with pytest.raises(ContractRejection):
+            tx.to_wire_transaction().to_ledger_transaction(
+                FakeServices([issue_stx])).verify()
+
+    def test_generate_spend_coin_selection_and_change(self):
+        issue_stx = issue_tx(qty=300).to_signed_transaction()
+        issue_stx2 = issue_tx(qty=500).to_signed_transaction()
+        tx = TransactionBuilder(notary=NOTARY)
+        owners = Cash.generate_spend(
+            tx, Amount(600, USD), BOB.owning_key,
+            [issue_stx.tx.out_ref(0), issue_stx2.tx.out_ref(0)])
+        assert owners == [ALICE.owning_key]
+        paid = sum(o.data.amount.quantity for o in tx.outputs
+                   if o.data.owner == BOB.owning_key)
+        change = sum(o.data.amount.quantity for o in tx.outputs
+                     if o.data.owner == ALICE.owning_key)
+        assert paid == 600 and change == 200
+
+    def test_generate_spend_insufficient(self):
+        issue_stx = issue_tx(qty=100).to_signed_transaction()
+        tx = TransactionBuilder(notary=NOTARY)
+        with pytest.raises(InsufficientBalanceException):
+            Cash.generate_spend(tx, Amount(600, USD), BOB.owning_key,
+                                [issue_stx.tx.out_ref(0)])
+
+
+class TestTwoPartyTrade:
+    def _setup(self):
+        net = MockNetwork()
+        notary = net.create_notary_node("Notary", validating=True)
+        seller = net.create_node("Seller")
+        buyer = net.create_node("Buyer")
+        return net, notary, seller, buyer
+
+    def test_dvp_trade_settles_atomically(self):
+        from corda_tpu.finance.trade import BuyerFlow, SellerFlow
+        from corda_tpu.testing.dummies import DummyContract
+
+        net, notary, seller, buyer = self._setup()
+        try:
+            # Buyer self-issues cash (as a cash issuer) and records it.
+            cash_issue = Cash.generate_issue(
+                Amount(1_000, USD), buyer.identity.ref(b"\x01"),
+                buyer.identity.owning_key, notary.identity)
+            cash_issue.sign_with(buyer.key)
+            cash_stx = cash_issue.to_signed_transaction()
+            buyer.record_transaction(cash_stx)
+
+            # Seller owns a dummy asset.
+            asset_issue = DummyContract.generate_initial(
+                seller.identity.ref(b"\x02"), 42, notary.identity)
+            asset_issue.sign_with(seller.key)
+            asset_stx = asset_issue.to_signed_transaction()
+            seller.record_transaction(asset_stx)
+            asset = asset_stx.tx.out_ref(0)
+
+            buyer.register_initiated_flow(
+                "SellerFlow",
+                lambda party: BuyerFlow(party, Amount(800, USD),
+                                        notary.identity))
+            handle = seller.start_flow(
+                SellerFlow(buyer.identity, asset, Amount(750, USD)))
+            net.run_network()
+            final = handle.result.result()
+
+            # Atomic settlement: the final tx moves BOTH legs.
+            wtx = final.tx
+            assert asset.ref in wtx.inputs
+            asset_outs = [o.data for o in wtx.outputs
+                          if not isinstance(o.data, CashState)]
+            assert [o.owner for o in asset_outs] == [buyer.identity.owning_key]
+            paid = sum(o.data.amount.quantity for o in wtx.outputs
+                       if isinstance(o.data, CashState)
+                       and o.data.owner == seller.identity.owning_key)
+            assert paid == 750
+            # Notary committed the inputs exactly once.
+            assert notary.uniqueness_provider.committed_count == len(wtx.inputs)
+            # Both sides recorded the final transaction (broadcast).
+            for node in (seller, buyer):
+                assert node.services.storage_service.validated_transactions \
+                    .get_transaction(final.id) is not None
+            # Buyer's vault: asset in, spent cash out, change in.
+            buyer_states = buyer.services.vault_service.current_vault.states
+            cash_left = sum(s.state.data.amount.quantity for s in buyer_states
+                            if isinstance(s.state.data, CashState))
+            assert cash_left == 250
+        finally:
+            net.stop_nodes()
+
+    def test_trade_rejected_when_price_too_high(self):
+        from corda_tpu.finance.trade import (
+            BuyerFlow, SellerFlow, UnacceptablePriceException,
+        )
+        from corda_tpu.testing.dummies import DummyContract
+
+        net, notary, seller, buyer = self._setup()
+        try:
+            asset_issue = DummyContract.generate_initial(
+                seller.identity.ref(b"\x02"), 43, notary.identity)
+            asset_issue.sign_with(seller.key)
+            asset_stx = asset_issue.to_signed_transaction()
+            seller.record_transaction(asset_stx)
+
+            buyer.register_initiated_flow(
+                "SellerFlow",
+                lambda party: BuyerFlow(party, Amount(100, USD),
+                                        notary.identity))
+            handle = seller.start_flow(SellerFlow(
+                buyer.identity, asset_stx.tx.out_ref(0), Amount(750, USD)))
+            net.run_network()
+            with pytest.raises(Exception):
+                handle.result.result()
+            assert notary.uniqueness_provider.committed_count == 0
+        finally:
+            net.stop_nodes()
