@@ -16,6 +16,17 @@ Providers:
                  optional shadow sampling: a fraction of batch results is
                  re-checked against the oracle so TPU divergence is detected
                  in production (SURVEY.md §7 hard part #5).
+
+ECDSA — an EXPLICIT deferral, not an oversight. The reference snapshot
+hardwires Ed25519 for every ledger signature: its "ECDSA"-named helpers
+construct EdDSAEngine (reference: core/src/main/kotlin/net/corda/core/crypto/
+CryptoUtilities.kt:63-96; there is no pluggable SignatureScheme SPI at 0.7).
+ECDSA secp256r1 appears ONLY in TLS/X.509 certificate plumbing
+(core/.../crypto/X509Utilities.kt:44-48), never on the transaction hot path,
+so a batched ECDSA verify kernel would have zero reference workload to serve.
+If later parity targets need it (TLS transport or post-0.7 Crypto SPI), the
+BatchVerifier seam is where it plugs in: VerifyJob grows a scheme tag and a
+secp256r1/k1 kernel joins ed25519_jax behind the same provider.
 """
 
 from __future__ import annotations

@@ -43,11 +43,18 @@ class NodeConfig:
     notary: str = "none"
     # For raft-* notaries: the names of ALL cluster members (incl. this node).
     raft_cluster: tuple[str, ...] = ()
-    network_map: Path | None = None  # shared netmap file
+    network_map: Path | None = None  # shared netmap file (bootstrap)
+    map_service: bool = False  # host the wire directory service on this node
+    map_node: str | None = None  # use the named node's directory service
     verifier: str = "cpu"  # cpu | jax | jax-shadow
     batch: BatchConfig = field(default_factory=BatchConfig)
     # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
     rpc_users: tuple = ()
+    # CorDapp modules: imported at node start so their @register_flow /
+    # @register decorators run; a module-level install(node) hook, if
+    # present, wires responders/services (the reference's CordaPluginRegistry
+    # ServiceLoader capability, AbstractNode.kt:170-173,340-352).
+    cordapps: tuple[str, ...] = ()
 
     @staticmethod
     def load(path: str | os.PathLike) -> "NodeConfig":
@@ -61,7 +68,8 @@ class NodeConfig:
     def from_dict(raw: dict, default_dir: Path | None = None) -> "NodeConfig":
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
-                 "network_map", "verifier", "batch", "rpc_users"}
+                 "network_map", "map_service", "map_node", "verifier", "batch",
+                 "rpc_users", "cordapps"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -84,6 +92,8 @@ class NodeConfig:
             raft_cluster=tuple(raw.get("raft_cluster", ())),
             network_map=(base / nm if nm and not os.path.isabs(nm) else
                          Path(nm) if nm else None),
+            map_service=bool(raw.get("map_service", False)),
+            map_node=raw.get("map_node"),
             verifier=raw.get("verifier", "cpu"),
             batch=BatchConfig(
                 max_sigs=int(batch.get("max_sigs", 4096)),
@@ -91,6 +101,7 @@ class NodeConfig:
             ),
             rpc_users=tuple(
                 dict(u) for u in raw.get("rpc_users", ())),
+            cordapps=tuple(raw.get("cordapps", ())),
         )
 
 

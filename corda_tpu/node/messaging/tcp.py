@@ -175,6 +175,7 @@ class TcpMessaging(MessagingService):
     """One node's TCP endpoint. Call start() to listen, pump() to dispatch."""
 
     RETRY_BACKOFF = (0.05, 0.1, 0.2, 0.5, 1.0)  # then every 1s
+    POISON_RETRIES = 50  # failed deliveries before a message is dropped
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, db=None):
         self._listen_host, self._listen_port = host, port
@@ -185,6 +186,7 @@ class TcpMessaging(MessagingService):
         # (reply_socket | None, Message) pairs awaiting dispatch on pump().
         self._inbound: "queue.Queue[tuple[Any, Message]]" = queue.Queue()
         self._pending_no_handler: list[tuple[Any, Message]] = []
+        self._poison: dict[bytes, int] = {}  # unique_id -> failed tries
         self._server: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._bridges: dict[str, threading.Thread] = {}
@@ -416,13 +418,41 @@ class TcpMessaging(MessagingService):
             self._pending_no_handler.append((conn, message))
             self._ack(conn, message.unique_id)
             return False
-        for h in handlers:
-            h.callback(message)
-        # Processed: record id durably, THEN ack (crash before this point
-        # means the sender redelivers; crash after means dedupe swallows it).
+        import logging
+
+        succeeded = failed = 0
+        for h in handlers:  # per-handler isolation: one failure must not
+            try:            # skip later handlers or kill the node's pump
+                h.callback(message)
+                succeeded += 1
+            except Exception:
+                failed += 1
+                logging.getLogger(__name__).exception(
+                    "handler failed for %s", message.topic_session)
+        if failed and not succeeded:
+            # Nothing processed: do NOT ack — the sender redelivers, giving
+            # transient failures (e.g. a SessionInit arriving before the
+            # network map knows the peer) time to resolve. A poison message
+            # that fails deterministically is dropped after a retry budget
+            # instead of redelivering forever.
+            tries = self._poison.get(message.unique_id, 0) + 1
+            if tries < self.POISON_RETRIES:
+                self._poison[message.unique_id] = tries
+                return False
+            logging.getLogger(__name__).error(
+                "dropping poison message on %s after %d failed deliveries",
+                message.topic_session, tries)
+            self._poison.pop(message.unique_id, None)
+        # Processed (or poison-dropped): record id durably, THEN ack (crash
+        # before this point means the sender redelivers; crash after means
+        # dedupe swallows it). If SOME handlers succeeded and others failed,
+        # we still ack — re-running the successful ones would duplicate side
+        # effects, which is worse than dropping the failed delivery (every
+        # production topic here has exactly one handler anyway).
+        self._poison.pop(message.unique_id, None)
         self._dedupe.record(message.unique_id)
         self._ack(conn, message.unique_id)
-        return True
+        return succeeded > 0
 
     def _ack(self, conn, unique_id: bytes) -> None:
         if conn is None:

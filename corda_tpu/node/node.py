@@ -175,7 +175,26 @@ class Node:
         self.scheduler = NodeSchedulerService(
             self.smm, self.services.vault_service)
 
+        # -- network map directory service (wire tier) ---------------------
+        self.netmap_service = None
+        self.netmap_client = None
+        if config.map_service:
+            from .services.netmap_service import NetworkMapService
+
+            self.netmap_service = NetworkMapService(self.messaging)
+
         install_data_vending(self.smm)
+
+        # -- CorDapps (reference: plugin ServiceLoader, AbstractNode.kt:
+        # 170-173,340-352): importing runs the registration decorators;
+        # install(node) wires responders.
+        import importlib
+
+        for module_name in config.cordapps:
+            module = importlib.import_module(module_name)
+            installer = getattr(module, "install", None)
+            if installer is not None:
+                installer(self)
 
         # -- RPC (reference: RPCDispatcher.kt, RPCUserService.kt) ----------
         self.rpc = None
@@ -230,6 +249,24 @@ class Node:
     def start(self) -> "Node":
         """Register in the map, restore checkpoints, resume flows."""
         self.register_and_refresh_netmap()
+        if self.config.map_node and self.config.map_node != self.config.name:
+            # Dynamic directory: the bootstrap file told us where the map
+            # node lives; from here on registration + updates ride the wire
+            # (reference: AbstractNode.registerWithNetworkMap,
+            # AbstractNode.kt:377-411).
+            from .services.netmap_service import NetworkMapClient
+
+            map_info = next(
+                (n for n in self.network_map_cache.party_nodes
+                 if n.legal_identity.name == self.config.map_node), None)
+            if map_info is None:
+                raise RuntimeError(
+                    f"map node {self.config.map_node!r} not in bootstrap map")
+            self.netmap_client = NetworkMapClient(
+                self.messaging, map_info.address, self.network_map_cache,
+                self.identity_service, self.key)
+            self.netmap_client.register(self.info)
+            self.netmap_client.fetch_and_subscribe()
         self.smm.start()
         self._started = True
         return self

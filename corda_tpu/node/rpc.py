@@ -29,6 +29,14 @@ from ..flows.api import flow_registry
 from ..serialization.codec import deserialize, register, serialize
 from .messaging.api import Message, MessagingService, TopicSession
 
+# Codec-whitelist imports: every type that can cross the RPC boundary must be
+# REGISTERED in the client process too, and registration happens at module
+# import. A standalone RpcClient (no Node constructed) would otherwise fail
+# to deserialize replies containing NodeInfo, StateAndRef, SignedTransaction…
+from ..contracts import structures as _structures  # noqa: F401
+from ..transactions import signed as _signed  # noqa: F401
+from .services import api as _services_api  # noqa: F401
+
 RPC_TOPIC = "platform.rpc"
 
 

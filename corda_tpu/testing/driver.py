@@ -1,0 +1,157 @@
+"""The multi-process driver: spawn REAL node processes for integration tests.
+
+Capability match for the reference's driver DSL (reference:
+node/src/main/kotlin/net/corda/node/driver/Driver.kt:56-107 — spawns real
+node JVMs with real transport + network-map registration, hands back handles;
+used by DriverTests, DistributedNotaryTests and every demo). Here each node
+is a `python -m corda_tpu.node.node <config.toml>` subprocess over real
+sockets and its own sqlite; the driver writes configs, waits for the "up at"
+banner, and exposes RPC handles and kill/restart for disruption tests.
+
+Usage:
+    with driver(tmp_path) as d:
+        notary = d.start_node("Notary", notary="simple")
+        party = d.start_node("Alice", cordapps=[...], rpc=True)
+        client = party.rpc("demo", "s3cret")
+        handle = client.start_flow("IssueAndNotariseFlow", 7)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+
+DEFAULT_RPC_USER = {"username": "demo", "password": "s3cret",
+                    "permissions": ["ALL"]}
+
+
+def _toml_escape(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    raise TypeError(f"cannot TOML-encode {v!r}")
+
+
+@dataclass
+class NodeProcess:
+    name: str
+    base_dir: Path
+    config_path: Path
+    process: subprocess.Popen
+    address: tuple[str, int] | None = None
+    rpc_users: list = field(default_factory=list)
+
+    def wait_up(self, timeout: float = 60.0) -> "NodeProcess":
+        """Block until the node prints its startup banner; parse the port."""
+        deadline = time.monotonic() + timeout
+        assert self.process.stdout is not None
+        while time.monotonic() < deadline:
+            if self.process.poll() is not None:
+                raise RuntimeError(
+                    f"node {self.name} exited with {self.process.returncode}")
+            line = self.process.stdout.readline()
+            if not line:
+                time.sleep(0.02)
+                continue
+            text = line.decode(errors="replace").strip()
+            if text.startswith(f"node {self.name} up at "):
+                host, port = text.rsplit(" ", 1)[-1].rsplit(":", 1)
+                self.address = (host, int(port))
+                return self
+        raise TimeoutError(f"node {self.name} did not come up in {timeout}s")
+
+    def rpc(self, user: str, password: str, timeout: float = 20.0):
+        from ..node.messaging.tcp import TcpAddress
+        from ..node.rpc import RpcClient
+
+        assert self.address is not None, "wait_up first"
+        return RpcClient(TcpAddress(*self.address), user, password,
+                         timeout=timeout)
+
+    def kill(self) -> None:
+        """SIGKILL — the Disruption.kt:18-60 'kill the process' primitive."""
+        self.process.kill()
+        self.process.wait(timeout=10)
+
+    def terminate(self) -> None:
+        self.process.terminate()
+        try:
+            self.process.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.process.kill()
+            self.process.wait(timeout=5)
+
+
+class Driver:
+    def __init__(self, base_dir: Path):
+        self.base_dir = Path(base_dir)
+        self.nodes: list[NodeProcess] = []
+        self.netmap = self.base_dir / "netmap.json"
+
+    def start_node(self, name: str, notary: str = "none",
+                   cordapps: tuple[str, ...] = (), rpc: bool = False,
+                   raft_cluster: tuple[str, ...] = (),
+                   wait: bool = True, extra_toml: str = "") -> NodeProcess:
+        node_dir = self.base_dir / name
+        node_dir.mkdir(parents=True, exist_ok=True)
+        lines = [
+            f"name = {_toml_escape(name)}",
+            f"base_dir = {_toml_escape(str(node_dir))}",
+            f"network_map = {_toml_escape(str(self.netmap))}",
+            f"notary = {_toml_escape(notary)}",
+        ]
+        if raft_cluster:
+            lines.append(
+                "raft_cluster = ["
+                + ", ".join(_toml_escape(n) for n in raft_cluster) + "]")
+        if cordapps:
+            lines.append(
+                "cordapps = ["
+                + ", ".join(_toml_escape(c) for c in cordapps) + "]")
+        rpc_users = [DEFAULT_RPC_USER] if rpc else []
+        for user in rpc_users:
+            lines.append("[[rpc_users]]")
+            lines.append(f"username = {_toml_escape(user['username'])}")
+            lines.append(f"password = {_toml_escape(user['password'])}")
+            lines.append("permissions = ["
+                         + ", ".join(_toml_escape(p)
+                                     for p in user["permissions"]) + "]")
+        if extra_toml:
+            lines.append(extra_toml)
+        config_path = node_dir / "node.toml"
+        config_path.write_text("\n".join(lines) + "\n")
+
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")  # node processes don't need TPU
+        process = subprocess.Popen(
+            [sys.executable, "-m", "corda_tpu.node.node", str(config_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd="/root/repo", env=env)
+        handle = NodeProcess(name, node_dir, config_path, process,
+                             rpc_users=rpc_users)
+        self.nodes.append(handle)
+        if wait:
+            handle.wait_up()
+        return handle
+
+    def stop_all(self) -> None:
+        for node in self.nodes:
+            if node.process.poll() is None:
+                node.terminate()
+
+
+@contextmanager
+def driver(base_dir: str | Path):
+    d = Driver(Path(base_dir))
+    try:
+        yield d
+    finally:
+        d.stop_all()

@@ -1,0 +1,208 @@
+"""CommercialPaper rules via the ledger DSL + DvP trade of paper.
+
+Mirrors the reference's CommercialPaperTests (reference: finance/src/test/
+kotlin/net/corda/contracts/CommercialPaperTests.kt) written in the test DSL
+(test-utils/.../TestDSL.kt), plus the trader-demo shape (SellerFlow/BuyerFlow
+wrapping TwoPartyTradeFlow over CommercialPaper).
+"""
+
+import pytest
+
+from corda_tpu.contracts.structures import Issued, Timestamp, now_micros
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.party import Party
+from corda_tpu.finance import Amount, CashState
+from corda_tpu.finance.cash import CashIssue, CashMove
+from corda_tpu.finance.commercial_paper import (
+    CommercialPaper,
+    CPIssue,
+    CPMove,
+    CPRedeem,
+    CPState,
+)
+from corda_tpu.testing.ledger_dsl import DslError, ledger
+
+MEGA_KEY = KeyPair.generate(b"\x41" * 32)
+MEGA = Party.of("MegaCorp", MEGA_KEY.public)
+ALICE_KEY = KeyPair.generate(b"\x42" * 32)
+ALICE = Party.of("Alice", ALICE_KEY.public)
+NOTARY = Party.of("Notary", KeyPair.generate(b"\x43" * 32).public)
+
+USD = "USD"
+NOW = now_micros()
+WEEK = 7 * 24 * 3600 * 1_000_000
+
+
+def issued_usd(qty):
+    return Amount(qty, Issued(MEGA.ref(b"\x01"), USD))
+
+
+def paper(owner=None, maturity=None):
+    return CPState(MEGA.ref(b"\x01"), owner or MEGA.owning_key,
+                   issued_usd(1000), maturity or NOW + WEEK)
+
+
+class TestCommercialPaperRules:
+    def test_issue_move_redeem_lifecycle(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output("paper", paper())
+            tx.command(CPIssue(), MEGA.owning_key)
+            tx.timestamp(Timestamp.around(NOW, 1000))
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("paper")
+            tx.output("alice's paper", paper(owner=ALICE.owning_key))
+            tx.command(CPMove(), MEGA.owning_key)
+            tx.verifies()
+        with l.transaction() as tx:  # redeem at maturity for cash
+            tx.input("alice's paper")
+            tx.output(CashState(issued_usd(1000), ALICE.owning_key))
+            tx.input(CashState(issued_usd(1000), MEGA.owning_key))
+            tx.command(CPRedeem(), ALICE.owning_key)
+            tx.command(CashMove(), MEGA.owning_key)
+            tx.timestamp(Timestamp.around(NOW + WEEK, 1000))
+            tx.verifies()
+
+    def test_issue_requires_issuer_signature(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output(paper())
+            tx.command(CPIssue(), ALICE.owning_key)  # not the issuer
+            tx.timestamp(Timestamp.around(NOW, 1000))
+            tx.fails_with("signed by the issuer")
+
+    def test_issue_requires_future_maturity(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output(paper(maturity=NOW - WEEK))
+            tx.command(CPIssue(), MEGA.owning_key)
+            tx.timestamp(Timestamp.around(NOW, 1000))
+            tx.fails_with("maturity date is in the future")
+
+    def test_cannot_redeem_before_maturity_with_tweak(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output("paper", paper(owner=ALICE.owning_key))
+            tx.command(CPIssue(), MEGA.owning_key)
+            tx.timestamp(Timestamp.around(NOW, 1000))
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("paper")
+            tx.output(CashState(issued_usd(1000), ALICE.owning_key))
+            tx.input(CashState(issued_usd(1000), MEGA.owning_key))
+            tx.command(CPRedeem(), ALICE.owning_key)
+            tx.command(CashMove(), MEGA.owning_key)
+            with tx.tweak() as tw:  # too early
+                tw.timestamp(Timestamp.around(NOW, 1000))
+                tw.fails_with("must have matured")
+            tx.timestamp(Timestamp.around(NOW + WEEK, 1000))
+            tx.verifies()
+
+    def test_redeem_must_pay_face_value(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(paper(owner=ALICE.owning_key))
+            tx.output(CashState(issued_usd(600), ALICE.owning_key))  # short
+            tx.output(CashState(issued_usd(400), MEGA.owning_key))
+            tx.input(CashState(issued_usd(1000), MEGA.owning_key))
+            tx.command(CPRedeem(), ALICE.owning_key)
+            tx.command(CashMove(), MEGA.owning_key)
+            tx.timestamp(Timestamp.around(NOW + WEEK, 1000))
+            tx.fails_with("face value")
+
+    def test_move_cannot_change_terms(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(paper())
+            bigger = CPState(MEGA.ref(b"\x01"), ALICE.owning_key,
+                             issued_usd(2000), NOW + WEEK)
+            tx.output(bigger)
+            tx.command(CPMove(), MEGA.owning_key)
+            # Different face value = a different group with no inputs and no
+            # issue command -> rejected.
+            tx.fails_with("CPRedeem")
+
+    def test_dsl_requires_verification_call(self):
+        l = ledger(NOTARY)
+        with pytest.raises(DslError, match="without verifies"):
+            with l.transaction() as tx:
+                tx.output(paper())
+
+
+class TestPaperTrade:
+    def test_dvp_trade_of_commercial_paper(self):
+        """trader-demo shape: seller holds paper, buyer pays cash — one
+        atomic swap through the validating notary."""
+        from corda_tpu.finance import Cash
+        from corda_tpu.finance.trade import BuyerFlow, SellerFlow
+        from corda_tpu.testing.mock_network import MockNetwork
+
+        net = MockNetwork()
+        try:
+            notary = net.create_notary_node("Notary", validating=True)
+            seller = net.create_node("Seller")
+            buyer = net.create_node("Buyer")
+
+            # Seller self-issues paper (it is its own issuer here). The
+            # timestamped issuance needs the notary's signature — obtain it
+            # through the notarisation flow before the paper can be traded.
+            from corda_tpu.flows.notary import NotaryClientFlow
+
+            issue = CommercialPaper.generate_issue(
+                seller.identity.ref(b"\x01"), Amount(
+                    900, Issued(seller.identity.ref(b"\x01"), USD)),
+                now_micros() + WEEK, notary.identity)
+            issue.set_time(Timestamp.around(now_micros(), 30_000_000))
+            issue.sign_with(seller.key)
+            issue_stx = issue.to_signed_transaction(
+                check_sufficient_signatures=False)
+            h = seller.start_flow(NotaryClientFlow(issue_stx))
+            net.run_network()
+            issue_stx = issue_stx.with_additional_signature(h.result.result())
+            seller.record_transaction(issue_stx)
+
+            cash_issue = Cash.generate_issue(
+                Amount(1_000, USD), buyer.identity.ref(b"\x02"),
+                buyer.identity.owning_key, notary.identity)
+            cash_issue.sign_with(buyer.key)
+            cash_stx = cash_issue.to_signed_transaction()
+            buyer.record_transaction(cash_stx)
+
+            buyer.register_initiated_flow(
+                "SellerFlow",
+                lambda party: BuyerFlow(party, Amount(800, USD),
+                                        notary.identity))
+            handle = seller.start_flow(SellerFlow(
+                buyer.identity, issue_stx.tx.out_ref(0), Amount(750, USD)))
+            net.run_network()
+            final = handle.result.result()
+            papers = [o.data for o in final.tx.outputs
+                      if isinstance(o.data, CPState)]
+            assert [p.owner for p in papers] == [buyer.identity.owning_key]
+        finally:
+            net.stop_nodes()
+
+
+def test_two_identical_papers_cannot_share_one_payment():
+    """Regression: N identical papers in one group must each claim their own
+    cash — a single face-value payment cannot extinguish both."""
+    l = ledger(NOTARY)
+    with l.transaction() as tx:
+        tx.input(paper(owner=ALICE.owning_key))
+        tx.input(paper(owner=ALICE.owning_key))  # identical twin
+        tx.output(CashState(issued_usd(1000), ALICE.owning_key))  # only ONE
+        tx.input(CashState(issued_usd(1000), MEGA.owning_key))
+        tx.command(CPRedeem(), ALICE.owning_key)
+        tx.command(CashMove(), MEGA.owning_key)
+        tx.timestamp(Timestamp.around(NOW + WEEK, 1000))
+        tx.fails_with("face value")
+    with l.transaction() as tx:  # paying for both is fine
+        tx.input(paper(owner=ALICE.owning_key))
+        tx.input(paper(owner=ALICE.owning_key))
+        tx.output(CashState(issued_usd(2000), ALICE.owning_key))
+        tx.input(CashState(issued_usd(2000), MEGA.owning_key))
+        tx.command(CPRedeem(), ALICE.owning_key)
+        tx.command(CashMove(), MEGA.owning_key)
+        tx.timestamp(Timestamp.around(NOW + WEEK, 1000))
+        tx.verifies()

@@ -264,3 +264,69 @@ class TestSessionErrors:
         net.run_network()
         with pytest.raises(Exception):
             handle.result.result()
+
+
+class TestNotaryChange:
+    def test_notary_change_unanimous_consent(self):
+        """A shared state moves to a new notary once every participant signs
+        (reference: NotaryChangeTests.kt over AbstractStateReplacementFlow)."""
+        from corda_tpu.flows.state_replacement import (
+            NotaryChangeFlow,
+            install_notary_change_acceptor,
+        )
+        from corda_tpu.testing.dummies import DummyMultiOwnerState
+        from corda_tpu.contracts.structures import Command
+        from corda_tpu.testing.dummies import DummyCreate
+        from corda_tpu.transactions.builder import TransactionBuilder
+
+        net = MockNetwork(verifier=CpuVerifier())
+        try:
+            notary_a = net.create_notary_node("NotaryA")
+            notary_b = net.create_notary_node("NotaryB")
+            alice = net.create_node("Alice")
+            bob = net.create_node("Bob")
+            install_notary_change_acceptor(bob.smm)
+
+            # A state co-owned by alice and bob, on notary A.
+            state = DummyMultiOwnerState(
+                7, (alice.identity.owning_key, bob.identity.owning_key))
+            tx = TransactionBuilder(notary=notary_a.identity)
+            tx.add_output_state(state)
+            tx.add_command(Command(DummyCreate(), (alice.identity.owning_key,)))
+            tx.sign_with(alice.key)
+            issue_stx = tx.to_signed_transaction()
+            alice.record_transaction(issue_stx)
+            bob.record_transaction(issue_stx)
+
+            handle = alice.start_flow(NotaryChangeFlow(
+                issue_stx.tx.out_ref(0), notary_b.identity))
+            net.run_network()
+            new_ref = handle.result.result()
+            assert new_ref.state.notary == notary_b.identity
+            assert new_ref.state.data == state
+            # The old notary committed the consumed input exactly once.
+            assert notary_a.uniqueness_provider.committed_count == 1
+            # Both parties recorded the replacement.
+            for node in (alice, bob):
+                assert node.services.storage_service.validated_transactions \
+                    .get_transaction(new_ref.ref.txhash) is not None
+        finally:
+            net.stop_nodes()
+
+    def test_notary_change_same_notary_rejected(self):
+        from corda_tpu.flows.state_replacement import (
+            NotaryChangeFlow,
+            StateReplacementException,
+        )
+
+        net = MockNetwork(verifier=CpuVerifier())
+        try:
+            notary, alice, bob = make_parties(net)
+            issue_stx = issue_to(net, alice, notary.identity, magic=77)
+            handle = alice.start_flow(NotaryChangeFlow(
+                issue_stx.tx.out_ref(0), notary.identity))
+            net.run_network()
+            with pytest.raises(StateReplacementException):
+                handle.result.result()
+        finally:
+            net.stop_nodes()

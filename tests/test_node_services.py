@@ -261,3 +261,77 @@ def test_progress_tracker_stream_and_children():
         ("Signing",),
         ("Done",),
     ]
+
+
+# ---------------------------------------------------------------------------
+# Network map directory service (wire tier)
+# ---------------------------------------------------------------------------
+
+
+def test_netmap_service_register_fetch_subscribe(tmp_path):
+    """A map node serves signed registrations; late joiners learn earlier
+    nodes over the wire (not from the bootstrap file), and registrations not
+    signed by the registering identity are rejected."""
+    map_node = Node(NodeConfig(
+        name="MapNode", base_dir=tmp_path / "MapNode",
+        network_map=tmp_path / "netmap.json", map_service=True)).start()
+    a = Node(NodeConfig(
+        name="NodeA", base_dir=tmp_path / "NodeA",
+        network_map=tmp_path / "netmap.json", map_node="MapNode")).start()
+    nodes = [map_node, a]
+    try:
+        pump_until(nodes, lambda: a.netmap_client.registered
+                   and a.netmap_client.fetched)
+        assert map_node.netmap_service.node_count == 1
+
+        # NodeB never touches the bootstrap file after boot; it learns NodeA
+        # through fetch, and NodeA learns NodeB through the pushed update.
+        b = Node(NodeConfig(
+            name="NodeB", base_dir=tmp_path / "NodeB",
+            network_map=tmp_path / "netmap.json", map_node="MapNode")).start()
+        nodes.append(b)
+        pump_until(nodes, lambda: b.netmap_client.registered)
+        pump_until(nodes, lambda: any(
+            n.legal_identity.name == "NodeA"
+            for n in b.network_map_cache.party_nodes))
+        pump_until(nodes, lambda: any(
+            n.legal_identity.name == "NodeB"
+            for n in a.network_map_cache.party_nodes))
+
+        # Forged registration: NodeB signs a registration claiming NodeA's
+        # identity but pointing at B's OWN address (session hijack attempt).
+        # Rejected: the map's entry and serial for NodeA must not change.
+        from dataclasses import replace as _replace
+
+        from corda_tpu.node.services.netmap_service import (
+            ADD, NodeRegistration, RegistrationRequest,
+        )
+        from corda_tpu.crypto.signed_data import SignedData
+        from corda_tpu.serialization.codec import serialize
+        from corda_tpu.node.messaging.api import TopicSession
+
+        serial_before = map_node.netmap_service.serial_of("NodeA")
+        forged_info = _replace(a.info, address=b.messaging.my_address)
+        reg = NodeRegistration(forged_info, 999, ADD)
+        blob = serialize(reg)
+        signed = SignedData(blob, b.key.sign(blob.bytes))  # B signs as A
+        b.messaging.send(
+            TopicSession("platform.netmap", 0),
+            serialize(RegistrationRequest(
+                signed, b.messaging.my_address)).bytes,
+            map_node.messaging.my_address)
+        for _ in range(30):
+            for n in nodes:
+                n.run_once(timeout=0.005)
+        stored = map_node.netmap_service.get_node("NodeA")
+        assert stored is not None
+        assert stored.address == a.messaging.my_address  # NOT hijacked
+        assert map_node.netmap_service.serial_of("NodeA") == serial_before
+        # A legitimate re-register (next serial) still succeeds.
+        a.netmap_client.register(a.info)
+        pump_until(nodes, lambda:
+                   map_node.netmap_service.serial_of("NodeA")
+                   == serial_before + 1)
+    finally:
+        for n in nodes:
+            n.stop()
