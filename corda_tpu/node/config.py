@@ -46,6 +46,8 @@ class NodeConfig:
     network_map: Path | None = None  # shared netmap file (bootstrap)
     map_service: bool = False  # host the wire directory service on this node
     map_node: str | None = None  # use the named node's directory service
+    tls: bool = False  # mutual TLS on the transport (dev CA auto-generated)
+    web_port: int | None = None  # HTTP API (status/metrics/attachments)
     verifier: str = "cpu"  # cpu | jax | jax-shadow
     batch: BatchConfig = field(default_factory=BatchConfig)
     # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
@@ -68,8 +70,8 @@ class NodeConfig:
     def from_dict(raw: dict, default_dir: Path | None = None) -> "NodeConfig":
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
-                 "network_map", "map_service", "map_node", "verifier", "batch",
-                 "rpc_users", "cordapps"}
+                 "network_map", "map_service", "map_node", "tls", "web_port",
+                 "verifier", "batch", "rpc_users", "cordapps"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -94,6 +96,9 @@ class NodeConfig:
                          Path(nm) if nm else None),
             map_service=bool(raw.get("map_service", False)),
             map_node=raw.get("map_node"),
+            tls=bool(raw.get("tls", False)),
+            web_port=(int(raw["web_port"])
+                      if raw.get("web_port") is not None else None),
             verifier=raw.get("verifier", "cpu"),
             batch=BatchConfig(
                 max_sigs=int(batch.get("max_sigs", 4096)),

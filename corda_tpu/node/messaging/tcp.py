@@ -177,8 +177,27 @@ class TcpMessaging(MessagingService):
     RETRY_BACKOFF = (0.05, 0.1, 0.2, 0.5, 1.0)  # then every 1s
     POISON_RETRIES = 50  # failed deliveries before a message is dropped
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, db=None):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, db=None,
+                 tls: dict | None = None):
+        # tls: {"ca": Path, "cert": Path, "key": Path} PEMs — mutual TLS
+        # chained to the network's shared dev CA (the reference's
+        # Artemis-over-TLS capability, ArtemisMessagingComponent tcpTransport
+        # + X509Utilities.kt:223-309). None = plaintext.
         self._listen_host, self._listen_port = host, port
+        self._tls_server_ctx = self._tls_client_ctx = None
+        if tls is not None:
+            import ssl
+
+            server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            server_ctx.load_cert_chain(str(tls["cert"]), str(tls["key"]))
+            server_ctx.load_verify_locations(str(tls["ca"]))
+            server_ctx.verify_mode = ssl.CERT_REQUIRED  # mutual auth
+            client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            client_ctx.load_cert_chain(str(tls["cert"]), str(tls["key"]))
+            client_ctx.load_verify_locations(str(tls["ca"]))
+            client_ctx.check_hostname = False  # identity = CA membership;
+            # addresses are ephemeral in dev networks
+            self._tls_server_ctx, self._tls_client_ctx = server_ctx, client_ctx
         self._db = db
         self._outbox = _Outbox(db)
         self._dedupe = _Dedupe(db)
@@ -279,10 +298,19 @@ class TcpMessaging(MessagingService):
                     return
                 continue
             try:
+                # wrap_socket() detaches the raw socket, so close the WRAPPED
+                # one explicitly — the with-block alone would leak TLS fds.
+                import contextlib
+
                 with socket.create_connection((host, int(port_s)),
-                                              timeout=5.0) as sock:
-                    attempt = 0
-                    self._replay_outbox(peer, sock)
+                                              timeout=5.0) as raw:
+                    sock = raw
+                    if self._tls_client_ctx is not None:
+                        sock = self._tls_client_ctx.wrap_socket(
+                            raw, server_hostname=host)
+                    with contextlib.closing(sock):
+                        attempt = 0
+                        self._replay_outbox(peer, sock)
             except OSError:
                 backoff = self.RETRY_BACKOFF[
                     min(attempt, len(self.RETRY_BACKOFF) - 1)]
@@ -331,10 +359,26 @@ class TcpMessaging(MessagingService):
                 continue
             except OSError:
                 return
-            t = threading.Thread(target=self._reader_loop, args=(conn,),
+            # TLS handshake (if any) happens on the per-connection reader
+            # thread — a stalled peer must not head-of-line block accept().
+            t = threading.Thread(target=self._serve_connection, args=(conn,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        if self._tls_server_ctx is not None:
+            try:
+                conn.settimeout(5.0)
+                conn = self._tls_server_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except (OSError, ValueError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return  # plaintext/un-CA'd peers are refused
+        self._reader_loop(conn)
 
     def _reader_loop(self, conn: socket.socket) -> None:
         try:

@@ -82,13 +82,25 @@ class Node:
             stored = self.db.get_setting("listen_port")
             if stored is not None:
                 port = int(stored)
+        tls_paths = None
+        if config.tls:
+            # Dev-mode TLS: certs chain to a shared dev CA living beside the
+            # network map file (configureWithDevSSLCertificate capability).
+            from ..crypto.x509 import generate_dev_tls_material
+
+            shared = (config.network_map.parent if config.network_map
+                      else config.base_dir)
+            tls_paths = generate_dev_tls_material(
+                config.base_dir, shared, config.name, config.host)
         try:
-            self.messaging = TcpMessaging(config.host, port, db=self.db)
+            self.messaging = TcpMessaging(config.host, port, db=self.db,
+                                          tls=tls_paths)
             self.messaging.start()
         except OSError:
             # Stored port taken (another process got it) — fall back to
             # ephemeral; netmap re-registration updates peers going forward.
-            self.messaging = TcpMessaging(config.host, 0, db=self.db)
+            self.messaging = TcpMessaging(config.host, 0, db=self.db,
+                                          tls=tls_paths)
             self.messaging.start()
         self.db.set_setting("listen_port", str(self.messaging.my_address.port))
 
@@ -207,6 +219,13 @@ class Node:
                 for u in config.rpc_users)
             self.rpc = RpcDispatcher(self, users)
 
+        # -- web API (reference: Node.kt Jetty tier, APIServer.kt) ---------
+        self.webserver = None
+        if config.web_port is not None:
+            from .webserver import NodeWebServer
+
+            self.webserver = NodeWebServer(self, config.host, config.web_port)
+
         self._started = False
 
     # -- network map -------------------------------------------------------
@@ -312,6 +331,8 @@ class Node:
             self.refresh_netmap()
 
     def stop(self) -> None:
+        if self.webserver is not None:
+            self.webserver.stop()
         self.messaging.stop()
         self.db.close()
 

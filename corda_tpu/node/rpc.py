@@ -132,6 +132,16 @@ class NodeRpcOps:
     def node_identity(self):
         return self._node.identity
 
+    # -- observability (MonitoringService.kt:11 capability: the metric
+    # registry, exported here over RPC instead of JMX) ---------------------
+
+    def node_metrics(self) -> dict:
+        smm = self._node.smm
+        return dict(smm.metrics) | {
+            "flows_in_flight": smm.in_flight_count,
+            "verify_pending_sigs": smm.verify_pending_sigs,
+        }
+
 
 class RpcDispatcher:
     """Server side: authenticate, dispatch, reply (RPCDispatcher.kt:33-60)."""

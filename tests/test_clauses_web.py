@@ -1,0 +1,155 @@
+"""Clause framework, node web API, and cluster service identities.
+
+Mirrors the reference's clause tests (reference: core/src/test/kotlin/net/
+corda/core/contracts/clauses/*), the web servlets (node/.../servlets/
+DataUploadServlet.kt, AttachmentDownloadServlet.kt) and
+ServiceIdentityGenerator (node/.../utilities/ServiceIdentityGenerator.kt).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from corda_tpu.contracts.clauses import (
+    AllComposition,
+    AnyComposition,
+    Clause,
+    FirstComposition,
+    GroupClauseVerifier,
+    verify_clause,
+)
+from corda_tpu.contracts.dsl import RequirementFailed, require_that
+from corda_tpu.contracts.structures import AuthenticatedObject
+
+
+class _Cmd:
+    pass
+
+
+class _CmdA(_Cmd):
+    pass
+
+
+class _CmdB(_Cmd):
+    pass
+
+
+def auth(cmd):
+    return AuthenticatedObject((), (), cmd)
+
+
+class RecordingClause(Clause):
+    def __init__(self, name, required=(), fail=False):
+        self.name = name
+        self.required_commands = required
+        self.fail = fail
+        self.ran = 0
+
+    def verify(self, tx, inputs, outputs, commands, key):
+        self.ran += 1
+        with require_that() as req:
+            req(f"clause {self.name}", not self.fail)
+        return {c.value for c in self.get_matched_commands(commands)}
+
+
+class TestClauses:
+    def test_first_composition_dispatches_on_command(self):
+        issue = RecordingClause("issue", (_CmdA,))
+        move = RecordingClause("move", (_CmdB,))
+        cmds = [auth(_CmdB())]
+        verify_clause(None, FirstComposition(issue, move), cmds)
+        assert (issue.ran, move.ran) == (0, 1)
+
+    def test_all_composition_runs_every_match(self):
+        a = RecordingClause("a", (_CmdA,))
+        b = RecordingClause("b", (_CmdA,))
+        verify_clause(None, AllComposition(a, b), [auth(_CmdA())])
+        assert (a.ran, b.ran) == (1, 1)
+
+    def test_any_composition_requires_a_match(self):
+        a = RecordingClause("a", (_CmdA,))
+        with pytest.raises(RequirementFailed, match="no clause matched"):
+            verify_clause(None, AnyComposition(a), [auth(_CmdB())])
+
+    def test_failing_clause_propagates(self):
+        bad = RecordingClause("bad", (_CmdA,), fail=True)
+        with pytest.raises(RequirementFailed, match="clause bad"):
+            verify_clause(None, FirstComposition(bad), [auth(_CmdA())])
+
+    def test_unprocessed_declared_command_rejected(self):
+        class Lazy(Clause):
+            required_commands = (_CmdA,)
+
+            def verify(self, tx, inputs, outputs, commands, key):
+                return set()  # pretends to match but processes nothing
+
+        with pytest.raises(RequirementFailed, match="not processed"):
+            verify_clause(None, Lazy(), [auth(_CmdA())])
+
+    def test_group_clause_verifier_fans_groups(self):
+        class FakeGroup:
+            def __init__(self, key):
+                self.inputs, self.outputs, self.grouping_key = (), (), key
+
+        seen = []
+
+        class PerGroup(Clause):
+            required_commands = (_CmdA,)
+
+            def verify(self, tx, inputs, outputs, commands, key):
+                seen.append(key)
+                return {c.value for c in self.get_matched_commands(commands)}
+
+        class Verifier(GroupClauseVerifier):
+            def group_states(self, tx):
+                return [FakeGroup("g1"), FakeGroup("g2")]
+
+        verify_clause(None, Verifier(PerGroup()), [auth(_CmdA())])
+        assert seen == ["g1", "g2"]
+
+
+class TestWebServer:
+    def test_status_metrics_and_attachment_roundtrip(self, tmp_path):
+        from corda_tpu.node.config import NodeConfig
+        from corda_tpu.node.node import Node
+
+        node = Node(NodeConfig(
+            name="WebNode", base_dir=tmp_path / "WebNode",
+            network_map=tmp_path / "netmap.json", web_port=0)).start()
+        base = f"http://127.0.0.1:{node.webserver.port}"
+        try:
+            status = json.load(urllib.request.urlopen(f"{base}/api/status"))
+            assert status["name"] == "WebNode"
+            metrics = json.load(urllib.request.urlopen(f"{base}/api/metrics"))
+            assert "started" in metrics
+
+            blob = b"legal prose attachment" * 50
+            req = urllib.request.Request(
+                f"{base}/upload/attachment", data=blob, method="POST")
+            uploaded = json.load(urllib.request.urlopen(req))
+            att_id = uploaded["id"]
+            back = urllib.request.urlopen(
+                f"{base}/attachments/{att_id}").read()
+            assert back == blob
+
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/attachments/{'0' * 64}")
+        finally:
+            node.stop()
+
+
+class TestServiceIdentity:
+    def test_any_cluster_member_signature_validates(self):
+        from corda_tpu.crypto.keys import KeyPair
+        from corda_tpu.utils.service_identity import generate_service_identity
+
+        members = [KeyPair.generate(bytes([0x81 + i]) * 32) for i in range(3)]
+        cluster = generate_service_identity(
+            "Raft Notary Service", [m.public for m in members])
+        for member in members:
+            sig = member.sign(b"notarised-tx-id")
+            # 1-of-n composite: each member key fulfils the service identity.
+            assert cluster.owning_key.is_fulfilled_by({sig.by})
+        outsider = KeyPair.generate(b"\x99" * 32)
+        assert not cluster.owning_key.is_fulfilled_by({outsider.public})

@@ -1,0 +1,208 @@
+"""Oracle flows: query a fix, get a signature over a Merkle tear-off.
+
+Mirrors the reference's NodeInterestRatesTest + oracle privacy property
+(reference: samples/irs-demo/src/test/kotlin/net/corda/irs/api/
+NodeInterestRatesTest.kt; oracle at NodeInterestRates.kt:37-55): the oracle
+signs only when the revealed commands match its table, never sees other
+components, and a tampered tear-off is rejected.
+"""
+
+import pytest
+
+from corda_tpu.contracts.structures import Command
+from corda_tpu.crypto.provider import CpuVerifier
+from corda_tpu.flows.api import FlowException
+from corda_tpu.flows.oracle import (
+    Fix,
+    FixOf,
+    RateOracle,
+    RatesFixQueryFlow,
+    RatesFixSignFlow,
+)
+from corda_tpu.testing.dummies import DummyContract
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+LIBOR_3M = FixOf("LIBOR", 20_000, "3M")
+RATE = 5_6700  # 5.67% scaled by 10^4
+
+
+def _setup():
+    net = MockNetwork(verifier=CpuVerifier())
+    notary = net.create_notary_node("Notary")
+    oracle_node = net.create_node("Oracle Inc")
+    alice = net.create_node("Alice")
+    oracle = RateOracle(oracle_node.smm, oracle_node.key, {LIBOR_3M: RATE})
+    return net, notary, oracle_node, alice, oracle
+
+
+def _fixed_tx(alice, notary, fix: Fix):
+    """A transaction carrying the fix as a command (plus a dummy state)."""
+    builder = DummyContract.generate_initial(
+        alice.identity.ref(b"\x01"), 5, notary.identity)
+    builder.add_command(Command(fix, (alice.identity.owning_key,)))
+    builder.sign_with(alice.key)
+    return builder.to_signed_transaction(check_sufficient_signatures=False)
+
+
+def test_query_then_sign_over_tear_off():
+    net, notary, oracle_node, alice, oracle = _setup()
+    try:
+        qh = alice.start_flow(RatesFixQueryFlow(oracle_node.identity, LIBOR_3M))
+        net.run_network()
+        fix = qh.result.result()
+        assert fix == Fix(LIBOR_3M, RATE)
+
+        stx = _fixed_tx(alice, notary, fix)
+        sh = alice.start_flow(RatesFixSignFlow(oracle_node.identity, stx))
+        net.run_network()
+        sig = sh.result.result()
+        sig.verify(stx.id.bytes)
+        assert sig.by == oracle_node.key.public
+    finally:
+        net.stop_nodes()
+
+
+def test_oracle_rejects_wrong_fix_value():
+    net, notary, oracle_node, alice, oracle = _setup()
+    try:
+        bad_fix = Fix(LIBOR_3M, RATE + 1)  # not what the oracle published
+        stx = _fixed_tx(alice, notary, bad_fix)
+        sh = alice.start_flow(RatesFixSignFlow(oracle_node.identity, stx))
+        net.run_network()
+        with pytest.raises(Exception, match="incorrect fix"):
+            sh.result.result()
+    finally:
+        net.stop_nodes()
+
+
+def test_oracle_rejects_unknown_fix_query():
+    net, notary, oracle_node, alice, oracle = _setup()
+    try:
+        qh = alice.start_flow(RatesFixQueryFlow(
+            oracle_node.identity, FixOf("EURIBOR", 20_000, "6M")))
+        net.run_network()
+        with pytest.raises(Exception, match="unknown fix"):
+            qh.result.result()
+    finally:
+        net.stop_nodes()
+
+
+def test_oracle_privacy_only_commands_revealed():
+    """The tear-off the oracle receives contains ONLY the Fix commands: a
+    client revealing outputs gets refused."""
+    from corda_tpu.transactions.filtered import (
+        FilteredTransaction,
+        FilterFuns,
+    )
+
+    net, notary, oracle_node, alice, oracle = _setup()
+    try:
+        fix = Fix(LIBOR_3M, RATE)
+        stx = _fixed_tx(alice, notary, fix)
+        leaky = FilteredTransaction.build_merkle_transaction(
+            stx.tx, FilterFuns(
+                filter_commands=lambda c: isinstance(c.value, Fix),
+                filter_outputs=lambda _o: True))  # oversharing
+        with pytest.raises(FlowException, match="only see commands"):
+            oracle.sign(leaky, stx.id)
+
+        # And a proof against the WRONG id fails.
+        proper = FilteredTransaction.build_merkle_transaction(
+            stx.tx, FilterFuns(
+                filter_commands=lambda c: isinstance(c.value, Fix)))
+        from corda_tpu.crypto.hashes import SecureHash
+
+        with pytest.raises(FlowException, match="Merkle proof"):
+            oracle.sign(proper, SecureHash.zero())
+    finally:
+        net.stop_nodes()
+
+
+class TestTwoPartyDeal:
+    def test_deal_agreed_signed_and_finalised(self):
+        """TwoPartyDealFlow capability (TwoPartyDealFlow.kt): instigator
+        proposes, acceptor validates terms and signs, finality notarises and
+        both record the deal."""
+        from dataclasses import dataclass, field
+
+        from corda_tpu.contracts.structures import (
+            Contract,
+            DealState,
+            TypeOnlyCommandData,
+            UniqueIdentifier,
+        )
+        from corda_tpu.crypto.hashes import SecureHash
+        from corda_tpu.crypto.party import Party
+        from corda_tpu.flows.deal import DealAcceptorFlow, DealInstigatorFlow
+        from corda_tpu.serialization.codec import register
+
+        @register
+        @dataclass(frozen=True)
+        class SwapCommand(TypeOnlyCommandData):
+            pass
+
+        class _SwapContract(Contract):
+            def verify(self, tx):
+                pass
+
+            @property
+            def legal_contract_reference(self):
+                return SecureHash.sha256(b"swap")
+
+        @register
+        @dataclass(frozen=True)
+        class SwapDeal(DealState):
+            party_a: Party = None
+            party_b: Party = None
+            notional: int = 0
+            uid: UniqueIdentifier = field(default_factory=UniqueIdentifier)
+
+            @property
+            def linear_id(self):
+                return self.uid
+
+            @property
+            def contract(self):
+                return _SwapContract()
+
+            @property
+            def participants(self):
+                return [self.party_a.owning_key, self.party_b.owning_key]
+
+            @property
+            def parties(self):
+                return [self.party_a, self.party_b]
+
+        net = MockNetwork(verifier=CpuVerifier())
+        try:
+            notary = net.create_notary_node("Notary")
+            alice = net.create_node("Alice")
+            bob = net.create_node("Bob")
+
+            accepted_terms = []
+
+            from corda_tpu.flows.api import register_flow
+
+            @register_flow(name="SwapAcceptor")
+            class SwapAcceptor(DealAcceptorFlow):
+                def validate_terms(self, deal):
+                    accepted_terms.append(deal.notional)
+                    if deal.notional > 1_000_000:
+                        raise FlowException("notional too large")
+
+            bob.register_initiated_flow(
+                "DealInstigatorFlow", lambda party: SwapAcceptor(party))
+
+            deal = SwapDeal(alice.identity, bob.identity, 500_000)
+            handle = alice.start_flow(DealInstigatorFlow(
+                bob.identity, deal, SwapCommand(), notary.identity))
+            net.run_network()
+            final = handle.result.result()
+            assert accepted_terms == [500_000]
+            assert len(final.sigs) == 3  # alice + bob + notary
+            for node in (alice, bob):
+                assert node.services.storage_service.validated_transactions \
+                    .get_transaction(final.id) is not None
+        finally:
+            net.stop_nodes()

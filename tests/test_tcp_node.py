@@ -212,3 +212,42 @@ class TestKillAtStepSqlite:
         finally:
             for n in nodes.values():
                 n.stop()
+
+
+class TestTlsTransport:
+    def test_notarisation_over_mutual_tls(self, tmp_path):
+        """TLS-enabled nodes (certs chained to the shared dev CA) complete a
+        notarisation; a plaintext client cannot talk to a TLS node."""
+        notary = make_node(tmp_path, "Notary", notary="simple", tls=True)
+        alice = make_node(tmp_path, "Alice", tls=True)
+        nodes = [notary, alice]
+        try:
+            for n in nodes:
+                n.refresh_netmap()
+            assert (tmp_path / "dev-ca.pem").exists()
+            assert (tmp_path / "Alice" / "certificates" / "tls-cert.pem").exists()
+            stx = issue_and_move(alice, notary.identity, magic=21)
+            h = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(nodes, lambda: h.result.done)
+            h.result.result().verify(stx.id.bytes)
+
+            # A plaintext endpoint is refused by the TLS listener: its sends
+            # never ack (handshake bytes are not a valid frame).
+            from corda_tpu.node.messaging.api import TopicSession
+            from corda_tpu.node.messaging.tcp import TcpMessaging
+
+            plain = TcpMessaging("127.0.0.1", 0).start()
+            plain.send(TopicSession("platform.session", 0), b"junk",
+                       notary.messaging.my_address)
+            import time as _t
+
+            before = notary.smm.metrics["started"]
+            deadline = _t.monotonic() + 1.5
+            while _t.monotonic() < deadline:
+                for n in nodes:
+                    n.run_once(timeout=0.01)
+            assert notary.smm.metrics["started"] == before
+            plain.stop()
+        finally:
+            for n in nodes:
+                n.stop()
