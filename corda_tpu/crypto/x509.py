@@ -70,6 +70,19 @@ def ensure_dev_ca(shared_dir: str | Path) -> tuple[Path, Path]:
         raise TimeoutError(
             f"dev CA generation by another process never finished "
             f"(stale {lock_path}? delete it to retry)")
+    try:
+        return _generate_ca(ca_cert_path, ca_key_path)
+    except BaseException:
+        # A crashed generation must not brick every later node start: drop
+        # the lock so the next starter retries.
+        try:
+            os.unlink(lock_path)
+        except OSError:
+            pass
+        raise
+
+
+def _generate_ca(ca_cert_path: Path, ca_key_path: Path) -> tuple[Path, Path]:
     now = datetime.datetime.now(datetime.timezone.utc)
     ca_key = ec.generate_private_key(ec.SECP256R1())
     ca_name = _name("corda_tpu Dev Root CA")

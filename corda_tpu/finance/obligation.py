@@ -92,8 +92,7 @@ class Obligation(Contract):
             token = group.grouping_key
             in_sum = sum(s.amount.quantity for s in group.inputs)
             out_sum = sum(s.amount.quantity for s in group.outputs)
-            if any(isinstance(c.value, ObligationNet) for c in tx.commands) \
-                    and len(group.inputs) >= 2:
+            if self._is_net_group(tx, group):
                 self._verify_net(tx, group)
             elif not group.inputs:
                 issue = select_command(tx.commands, ObligationIssue)
@@ -106,6 +105,7 @@ class Obligation(Contract):
             elif in_sum > out_sum:
                 settle = select_command(tx.commands, ObligationSettle)
                 settled = settle.value.amount
+                in_pairs = {(s.obligor, s.owner) for s in group.inputs}
                 with require_that() as req:
                     req("the settle amount covers the reduction",
                         settled.token == token
@@ -116,6 +116,10 @@ class Obligation(Contract):
                     req("the obligor signed the settlement",
                         all(s.obligor in settle.signers
                             for s in group.inputs))
+                    req("the remainder keeps its original obligor and "
+                        "beneficiary",  # debt cannot be reassigned here
+                        all((o.obligor, o.owner) in in_pairs
+                            for o in group.outputs))
             else:
                 move = select_command(tx.commands, ObligationMove)
 
@@ -157,6 +161,17 @@ class Obligation(Contract):
                 return False
             covered += reduction
         return covered == settled_quantity
+
+    @staticmethod
+    def _is_net_group(tx, group) -> bool:
+        """A group is a netting when the GROUP ITSELF holds obligations in
+        both directions between one pair — the tx-wide command alone must not
+        reroute an unrelated group in the same transaction."""
+        if not any(isinstance(c.value, ObligationNet) for c in tx.commands):
+            return False
+        directed = {(s.obligor, s.owner) for s in group.inputs}
+        undirected = {frozenset(p) for p in directed}
+        return len(undirected) == 1 and len(directed) == 2
 
     @staticmethod
     def _verify_net(tx, group) -> None:
@@ -208,8 +223,16 @@ class Obligation(Contract):
     def generate_settle(tx: TransactionBuilder, obligations: list[StateAndRef],
                         cash_states: list[StateAndRef],
                         amount: Amount) -> None:
-        """Pay `amount` of the obligations' token from the obligor's cash."""
+        """Pay `amount` of the obligations' token from the obligor's cash.
+        All obligations must share one obligor and one beneficiary — mixed
+        inputs would build a transaction the contract rejects."""
         token = obligations[0].state.data.amount.token
+        pairs = {(o.state.data.obligor, o.state.data.owner)
+                 for o in obligations}
+        if len(pairs) != 1:
+            raise ValueError(
+                "generate_settle needs a single (obligor, beneficiary) pair; "
+                "settle mixed obligations in separate transactions")
         total = sum(o.state.data.amount.quantity for o in obligations)
         if amount.quantity > total:
             raise ValueError("settling more than is owed")

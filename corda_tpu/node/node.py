@@ -183,9 +183,12 @@ class Node:
         if stored:
             self.services.vault_service.notify_all(stored)
         from .services.scheduler import NodeSchedulerService
+        from .services.vault_observers import CashBalanceMetricsObserver
 
         self.scheduler = NodeSchedulerService(
             self.smm, self.services.vault_service)
+        CashBalanceMetricsObserver(self.services.vault_service,
+                                   self.smm.metrics)
 
         # -- network map directory service (wire tier) ---------------------
         self.netmap_service = None
@@ -219,13 +222,7 @@ class Node:
                 for u in config.rpc_users)
             self.rpc = RpcDispatcher(self, users)
 
-        # -- web API (reference: Node.kt Jetty tier, APIServer.kt) ---------
         self.webserver = None
-        if config.web_port is not None:
-            from .webserver import NodeWebServer
-
-            self.webserver = NodeWebServer(self, config.host, config.web_port)
-
         self._started = False
 
     # -- network map -------------------------------------------------------
@@ -267,6 +264,14 @@ class Node:
 
     def start(self) -> "Node":
         """Register in the map, restore checkpoints, resume flows."""
+        # Web API binds here, not in __init__: a constructed-but-unstarted
+        # (or failed) node must not hold a listener or serve half-built
+        # state (reference: Node.kt starts Jetty inside start()).
+        if self.config.web_port is not None and self.webserver is None:
+            from .webserver import NodeWebServer
+
+            self.webserver = NodeWebServer(
+                self, self.config.host, self.config.web_port)
         self.register_and_refresh_netmap()
         if self.config.map_node and self.config.map_node != self.config.name:
             # Dynamic directory: the bootstrap file told us where the map

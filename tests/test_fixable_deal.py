@@ -1,0 +1,105 @@
+"""The full irs-demo composition: scheduler -> oracle -> fixing -> notary.
+
+Mirrors the reference's IRS fixing cycle (reference: samples/irs-demo —
+NodeSchedulerService launches FixingFlow on the fixing date; the flow
+queries NodeInterestRates, embeds the Fix, gets the oracle's tear-off
+signature and the counterparty's signature, and finalises through the
+notary). Runs over real TCP nodes so the scheduler tick is the node's own
+run loop.
+"""
+
+import time
+
+import pytest
+
+from corda_tpu.contracts.structures import Command, now_micros
+from corda_tpu.finance.fixable_deal import (
+    FixableDealState,
+    FixingFlow,
+    install_fixing_acceptor,
+)
+from corda_tpu.flows.oracle import Fix, FixOf, RateOracle
+from corda_tpu.node.config import NodeConfig
+from corda_tpu.node.node import Node
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(__file__))
+from test_tcp_node import pump_until  # noqa: E402
+
+
+LIBOR_3M = FixOf("LIBOR", 20_100, "3M")
+RATE = 4_2500
+
+
+def test_scheduled_fixing_end_to_end(tmp_path):
+    notary = Node(NodeConfig(name="Notary", base_dir=tmp_path / "Notary",
+                             notary="simple",
+                             network_map=tmp_path / "m.json")).start()
+    floater = Node(NodeConfig(name="Floater", base_dir=tmp_path / "Floater",
+                              network_map=tmp_path / "m.json")).start()
+    fixed = Node(NodeConfig(name="Fixed", base_dir=tmp_path / "Fixed",
+                            network_map=tmp_path / "m.json")).start()
+    oracle_node = Node(NodeConfig(name="Oracle",
+                                  base_dir=tmp_path / "Oracle",
+                                  network_map=tmp_path / "m.json")).start()
+    nodes = [notary, floater, fixed, oracle_node]
+    try:
+        for n in nodes:
+            n.refresh_netmap()
+        RateOracle(oracle_node.smm, oracle_node.key, {LIBOR_3M: RATE})
+        install_fixing_acceptor(fixed.smm)
+
+        # Put the deal on BOTH parties' ledgers, fixing due in ~0.2s.
+        from corda_tpu.transactions.builder import TransactionBuilder
+        from corda_tpu.contracts.structures import TypeOnlyCommandData
+        from corda_tpu.serialization.codec import register
+        from dataclasses import dataclass
+
+        @register
+        @dataclass(frozen=True)
+        class _Agree(TypeOnlyCommandData):
+            pass
+
+        deal = FixableDealState(
+            party_a=floater.identity, party_b=fixed.identity,
+            oracle=oracle_node.identity, fix_of=LIBOR_3M,
+            fix_at_micros=now_micros() + 200_000, notional=1_000_000)
+        tx = TransactionBuilder(notary=notary.identity)
+        tx.add_output_state(deal)
+        tx.add_command(Command(_Agree(), (floater.identity.owning_key,
+                                          fixed.identity.owning_key)))
+        tx.sign_with(floater.key)
+        tx.sign_with(fixed.key)
+        stx = tx.to_signed_transaction()
+        floater.services.record_transactions([stx])
+        fixed.services.record_transactions([stx])
+
+        # Scheduler sees the deal on the floater's node.
+        assert floater.scheduler.next_scheduled is not None
+
+        def fixed_everywhere():
+            for node in (floater, fixed):
+                states = node.services.vault_service.current_vault.states
+                fixed_deals = [s for s in states
+                               if isinstance(s.state.data, FixableDealState)
+                               and s.state.data.fixed_value is not None]
+                if len(fixed_deals) != 1:
+                    return False
+            return True
+
+        pump_until(nodes, fixed_everywhere, timeout=25.0)
+        # Verify the fixing everywhere: value came from the oracle, old deal
+        # consumed, notary committed it.
+        for node in (floater, fixed):
+            states = node.services.vault_service.current_vault.states
+            deals = [s.state.data for s in states
+                     if isinstance(s.state.data, FixableDealState)]
+            assert len(deals) == 1 and deals[0].fixed_value == RATE
+        assert notary.uniqueness_provider.committed_count == 1
+        # And nothing further is scheduled (the fixed deal has no next
+        # activity).
+        assert floater.scheduler.next_scheduled is None
+    finally:
+        for n in nodes:
+            n.stop()

@@ -165,3 +165,58 @@ def test_move_with_multiple_obligors_in_one_group():
         tx.output(owed(BANK, NOTARY, 50))
         tx.command(ObligationMove(), BOB.owning_key)
         tx.verifies()
+
+
+def test_settle_cannot_reassign_remainder_obligor():
+    """Regression: the settle remainder must keep the original obligor — a
+    settlement cannot transfer leftover debt to a party who never signed."""
+    EVE = Party.of("Eve", KeyPair.generate(b"\x75" * 32).public)
+    l = ledger(NOTARY)
+    with l.transaction() as tx:
+        tx.input(owed(ALICE, BOB, 1000))
+        tx.output(owed(EVE, BOB, 400))  # debt shoved onto Eve
+        tx.input(cash(ALICE, 600))
+        tx.output(cash(BOB, 600))
+        tx.command(ObligationSettle(Amount(600, TOKEN)), ALICE.owning_key)
+        tx.command(CashMove(), ALICE.owning_key)
+        tx.fails_with("original obligor")
+
+
+def test_net_command_does_not_hijack_unrelated_group():
+    """Regression: a move group in the same tx as a netting must still be
+    verified as a move (per-group dispatch, not tx-wide)."""
+    OTHER = Issued(ALICE.ref(b"\x02"), "GBP")
+
+    def owed_gbp(obligor, owner, qty):
+        return ObligationState(obligor.owning_key, Amount(qty, OTHER),
+                               owner.owning_key)
+
+    l = ledger(NOTARY)
+    with l.transaction() as tx:
+        # Group 1 (USD): a real bilateral netting.
+        tx.input(owed(ALICE, BOB, 1000))
+        tx.input(owed(BOB, ALICE, 300))
+        tx.output(owed(ALICE, BOB, 700))
+        tx.command(ObligationNet(), ALICE.owning_key, BOB.owning_key)
+        # Group 2 (GBP): two obligations simply moving to a new owner.
+        tx.input(owed_gbp(ALICE, BOB, 100))
+        tx.input(owed_gbp(BANK, BOB, 50))
+        tx.output(owed_gbp(ALICE, NOTARY, 100))
+        tx.output(owed_gbp(BANK, NOTARY, 50))
+        tx.command(ObligationMove(), BOB.owning_key)
+        tx.verifies()
+
+
+def test_generate_settle_rejects_mixed_pairs():
+    from corda_tpu.contracts.structures import StateAndRef, StateRef, \
+        TransactionState
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.transactions.builder import TransactionBuilder
+
+    iou1 = StateAndRef(TransactionState(owed(ALICE, BOB, 500), NOTARY),
+                       StateRef(SecureHash.sha256(b"a"), 0))
+    iou2 = StateAndRef(TransactionState(owed(BANK, BOB, 500), NOTARY),
+                       StateRef(SecureHash.sha256(b"b"), 0))
+    tx = TransactionBuilder(notary=NOTARY)
+    with pytest.raises(ValueError, match="single .obligor, beneficiary."):
+        Obligation.generate_settle(tx, [iou1, iou2], [], Amount(600, TOKEN))

@@ -203,3 +203,67 @@ class TestSimulation:
             assert len(senders) >= 3  # both banks and the notary spoke
         finally:
             sim.stop()
+
+
+class TestSmallUtils:
+    def test_non_empty_set(self):
+        from corda_tpu.utils.collections import NonEmptySet
+
+        s = NonEmptySet.of(1, 2, 3)
+        assert 2 in s and len(s) == 3
+        with pytest.raises(ValueError):
+            NonEmptySet([])
+        with pytest.raises(ValueError):
+            s - {1, 2, 3}
+        assert s & {2, 3} == {2, 3}
+
+    def test_progress_renderer_follows_feed(self, tmp_path):
+        import io
+
+        from corda_tpu.node.config import NodeConfig
+        from corda_tpu.node.node import Node
+        from corda_tpu.utils.progress import ProgressTracker, Step
+        from corda_tpu.utils.progress_render import ProgressRenderer
+        from corda_tpu.flows.api import FlowLogic, register_flow
+
+        @register_flow
+        class SteppyFlow(FlowLogic):
+            def __init__(self, n: int):
+                self.n = n
+                self.progress_tracker = ProgressTracker(
+                    Step("Working"), Step("Finishing"))
+
+            def call(self):
+                self.progress_tracker.next_step()
+                self.progress_tracker.next_step()
+                return self.n
+
+        node = Node(NodeConfig(name="P", base_dir=tmp_path / "P",
+                               network_map=tmp_path / "m.json")).start()
+        try:
+            out = io.StringIO()
+            renderer = ProgressRenderer(node.smm, out=out)
+            node.start_flow(SteppyFlow(1))
+            lines = renderer.poll()
+            text = "\n".join(lines)
+            assert "started" in text and "Working" in text \
+                and "Finishing" in text and "finished" in text
+        finally:
+            node.stop()
+
+    def test_cash_balance_metrics(self, tmp_path):
+        from corda_tpu.finance import Amount, Cash
+        from corda_tpu.node.config import NodeConfig
+        from corda_tpu.node.node import Node
+
+        node = Node(NodeConfig(name="B", base_dir=tmp_path / "B",
+                               network_map=tmp_path / "m.json")).start()
+        try:
+            issue = Cash.generate_issue(
+                Amount(1234, "USD"), node.identity.ref(b"\x01"),
+                node.identity.owning_key, node.identity)
+            issue.sign_with(node.key)
+            node.services.record_transactions([issue.to_signed_transaction()])
+            assert node.smm.metrics["balance.USD"] == 1234
+        finally:
+            node.stop()
