@@ -39,9 +39,19 @@ from ..transactions.signed import SignedTransaction
 
 class FixableDealContract(Contract):
     def verify(self, tx) -> None:
-        fix_cmd = select_command(tx.commands, Fix)
         deals_in = [s for s in tx.inputs if isinstance(s, FixableDealState)]
         deals_out = [s for s in tx.outputs if isinstance(s, FixableDealState)]
+        if not deals_in:
+            # Deal CREATION: no Fix involved yet — the agreement tx must
+            # simply put unfixed deals on ledger with both parties signing
+            # (signer completeness is the platform's must_sign check).
+            with require_that() as req:
+                req("a new deal starts unfixed",
+                    all(d.fixed_value is None for d in deals_out))
+                req("a deal-creation produces at least one deal",
+                    bool(deals_out))
+            return
+        fix_cmd = select_command(tx.commands, Fix)
         with require_that() as req:
             req("a fixing consumes exactly one unfixed deal",
                 len(deals_in) == 1 and deals_in[0].fixed_value is None)
@@ -119,7 +129,10 @@ class FixingFlow(FlowLogic):
         deal = sar.state.data
         me = self.service_hub.my_identity
         if me != deal.party_a:
-            raise FlowException("the floating-leg payer runs the fixing")
+            # BOTH participants' schedulers fire (each vault holds the deal);
+            # only the floating-leg payer acts — the other side exits quietly
+            # rather than erroring a flow per fixing.
+            return None
         other = deal.party_b
 
         fix = yield from self.sub_flow(
@@ -137,10 +150,8 @@ class FixingFlow(FlowLogic):
         ptx = ptx.with_additional_signature(oracle_sig)
 
         response = yield self.send_and_receive(other, ptx, object)
-        from ..crypto.keys import DigitalSignature
-
         their_sig = response.unwrap(
-            lambda s: self._check_sig(s, ptx, DigitalSignature.WithKey))
+            lambda s: self._check_sig(s, ptx, other))
         stx = ptx.with_additional_signature(their_sig)
         final = yield from self.sub_flow(
             FinalityFlow(stx, (me, other)))
@@ -155,9 +166,16 @@ class FixingFlow(FlowLogic):
         return StateAndRef(state, self.state_ref)
 
     @staticmethod
-    def _check_sig(sig, ptx, cls):
-        if not isinstance(sig, cls):
+    def _check_sig(sig, ptx, counterparty):
+        from ..crypto.keys import DigitalSignature
+
+        if not isinstance(sig, DigitalSignature.WithKey):
             raise FlowException("expected the counterparty's signature")
+        if sig.by not in counterparty.owning_key.keys:
+            # It must be THEIR signature — any other valid sig (ours, the
+            # oracle's) would only fail post-notarisation as SignersMissing.
+            raise FlowException(
+                f"signature is not by the counterparty {counterparty}")
         sig.verify(ptx.id.bytes)
         return sig
 
@@ -196,6 +214,11 @@ class FixingAcceptorFlow(FlowLogic):
         fixes = [c.value for c in wtx.commands if isinstance(c.value, Fix)]
         if len(fixes) != 1 or fixes[0].value != deal.fixed_value:
             raise FlowException("fix command does not match the fixed value")
+        if fixes[0].of != deal.fix_of:
+            # The oracle signature only proves SOME fix is genuine — it must
+            # be the fix THIS deal references, or a cheaper instrument's rate
+            # could be substituted.
+            raise FlowException("fix is for a different instrument")
         return ptx
 
 

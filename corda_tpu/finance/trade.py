@@ -126,8 +126,8 @@ class BuyerFlow(FlowLogic):
 
         my_key = self.service_hub.my_identity.owning_key
         tx = TransactionBuilder(notary=self.notary)
-        vault_states = list(
-            self.service_hub.vault_service.current_vault.states)
+        vault_states = self.service_hub.vault_service.unconsumed_states(
+            CashState)
         Cash.generate_spend(
             tx, trade.price, trade.seller_owner_key, vault_states,
             change_owner=my_key)

@@ -150,6 +150,14 @@ class NodeVaultService(VaultService):
     def current_vault(self) -> Vault:
         return Vault(tuple(self._unconsumed.values()))
 
+    def unconsumed_states(self, of_type: type | None = None) -> list:
+        """Typed vault query (reference: VaultService statesOfType — the
+        coin-selection entry point)."""
+        out = list(self._unconsumed.values())
+        if of_type is not None:
+            out = [s for s in out if isinstance(s.state.data, of_type)]
+        return out
+
     def _is_relevant(self, state) -> bool:
         ours = self._our_keys()
         return any(

@@ -329,6 +329,9 @@ def precompute_batch(pubkeys, msgs, sigs, bucket: int | None = None):
     sg = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
     r_enc[:n] = sg[:, :32]
     s_raw[:n] = sg[:, 32:]
+    # Per-signature SHA-512 + big-int mod L: both are C-speed (hashlib and
+    # CPython long division); a fully vectorized numpy mod-L was measured
+    # SLOWER at 64k-signature batches, so the simple loop stays.
     sha512 = hashlib.sha512
     h_rows = h_raw[:n]
     for i in range(n):

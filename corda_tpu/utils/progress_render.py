@@ -2,9 +2,10 @@
 
 Capability match for the reference's ANSIProgressRenderer (reference:
 node/src/main/kotlin/net/corda/node/utilities/ANSIProgressRenderer.kt:27 —
-live console display of a flow's hierarchical progress). Renders the state
-machine manager's bounded event feed; call render() from any loop (the CLI
-node does) or format_events() for a one-shot dump.
+live console display of a flow's hierarchical progress). Follows the state
+machine manager's bounded event feed: call poll() from any loop to print
+(and get back) the lines for new events; `in_flight` snapshots the current
+step path per live flow.
 """
 
 from __future__ import annotations

@@ -150,3 +150,31 @@ def test_mixed_large_batch():
             msg = msg + b"!"
         cases.append((pk, msg, sig))
     _run(cases)
+
+
+def test_shadow_sampling_detects_kernel_divergence(monkeypatch):
+    """SURVEY.md hard part #5: the CPU oracle stays authoritative — a
+    diverging kernel result must raise loudly, never pass silently."""
+    import numpy as np
+    import pytest
+
+    from corda_tpu.crypto import ref_ed25519 as ref
+    from corda_tpu.crypto.provider import JaxVerifier, VerifyJob
+    from corda_tpu.ops import ed25519_jax
+
+    sk = b"\x17" * 32
+    pk = ref.public_key(sk)
+    msg = b"shadowed"
+    sig = ref.sign(sk, msg)
+    jobs = [VerifyJob(pk, msg, sig)]
+
+    # Healthy kernel + shadow: passes.
+    ok = JaxVerifier(shadow_rate=1.0).verify_batch(jobs)
+    assert ok.tolist() == [True]
+
+    # Sabotage the kernel: flip every verdict. Shadow sampling must catch it.
+    real = ed25519_jax.verify_batch
+    monkeypatch.setattr(ed25519_jax, "verify_batch",
+                        lambda *a, **k: ~real(*a, **k))
+    with pytest.raises(RuntimeError, match="divergence"):
+        JaxVerifier(shadow_rate=1.0).verify_batch(jobs)

@@ -50,8 +50,10 @@ def test_scheduled_fixing_end_to_end(tmp_path):
         RateOracle(oracle_node.smm, oracle_node.key, {LIBOR_3M: RATE})
         install_fixing_acceptor(fixed.smm)
 
-        # Put the deal on BOTH parties' ledgers, fixing due in ~0.2s.
-        from corda_tpu.transactions.builder import TransactionBuilder
+        # Agree the deal through the REAL deal flow (both sign, notarised,
+        # broadcast) — the creation tx passes contract verification during
+        # the counterparty's resolution, and both vaults pick it up.
+        from corda_tpu.flows.deal import DealAcceptorFlow, DealInstigatorFlow
         from corda_tpu.contracts.structures import TypeOnlyCommandData
         from corda_tpu.serialization.codec import register
         from dataclasses import dataclass
@@ -61,22 +63,22 @@ def test_scheduled_fixing_end_to_end(tmp_path):
         class _Agree(TypeOnlyCommandData):
             pass
 
+        fixed.smm.register_flow_initiator(
+            "DealInstigatorFlow", lambda party: DealAcceptorFlow(party))
         deal = FixableDealState(
             party_a=floater.identity, party_b=fixed.identity,
             oracle=oracle_node.identity, fix_of=LIBOR_3M,
-            fix_at_micros=now_micros() + 200_000, notional=1_000_000)
-        tx = TransactionBuilder(notary=notary.identity)
-        tx.add_output_state(deal)
-        tx.add_command(Command(_Agree(), (floater.identity.owning_key,
-                                          fixed.identity.owning_key)))
-        tx.sign_with(floater.key)
-        tx.sign_with(fixed.key)
-        stx = tx.to_signed_transaction()
-        floater.services.record_transactions([stx])
-        fixed.services.record_transactions([stx])
+            fix_at_micros=now_micros() + 700_000, notional=1_000_000)
+        h = floater.start_flow(DealInstigatorFlow(
+            fixed.identity, deal, _Agree(), notary.identity))
+        pump_until(nodes, lambda: h.result.done)
+        h.result.result()
 
-        # Scheduler sees the deal on the floater's node.
-        assert floater.scheduler.next_scheduled is not None
+        # BOTH schedulers see the deal (each holds it); only the floater's
+        # fixing flow acts — the counterparty's exits quietly.
+        pump_until(nodes, lambda:
+                   floater.scheduler.next_scheduled is not None
+                   and fixed.scheduler.next_scheduled is not None)
 
         def fixed_everywhere():
             for node in (floater, fixed):
