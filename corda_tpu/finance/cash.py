@@ -66,10 +66,21 @@ class CashExit(CommandData):
 @register
 @dataclass(frozen=True)
 class CashState(FungibleAsset):
-    """An amount of issued currency owned by a key (Cash.kt State)."""
+    """An amount of issued currency owned by a key (Cash.kt State).
+
+    Also queryable: projects to the `cash_states` table (the reference's
+    CashSchemaV1, finance/.../schemas/CashSchemaV1.kt)."""
 
     amount: Amount = None  # type: ignore[assignment]  # Amount of Issued
     owner: CompositeKey = None  # type: ignore[assignment]
+
+    def to_schema_row(self):
+        return ("cash_states", {
+            "currency": str(self.amount.token.product),
+            "quantity": self.amount.quantity,
+            "issuer": self.amount.token.issuer.party.name,
+            "owner_key": self.owner.to_base58_string(),
+        })
 
     @property
     def contract(self) -> Contract:

@@ -41,15 +41,20 @@ class FixableDealContract(Contract):
     def verify(self, tx) -> None:
         deals_in = [s for s in tx.inputs if isinstance(s, FixableDealState)]
         deals_out = [s for s in tx.outputs if isinstance(s, FixableDealState)]
+        all_signers = {k for c in tx.commands for k in c.signers}
         if not deals_in:
-            # Deal CREATION: no Fix involved yet — the agreement tx must
-            # simply put unfixed deals on ledger with both parties signing
-            # (signer completeness is the platform's must_sign check).
+            # Deal CREATION: the agreement tx puts unfixed deals on ledger;
+            # every participant must be a DECLARED signer (the builder
+            # chooses the signer list, so the contract — not must_sign —
+            # is what forces both parties' signatures onto the tx).
             with require_that() as req:
                 req("a new deal starts unfixed",
                     all(d.fixed_value is None for d in deals_out))
                 req("a deal-creation produces at least one deal",
                     bool(deals_out))
+                req("every participant signs the deal creation",
+                    all(k in all_signers for d in deals_out
+                        for k in d.participants))
             return
         fix_cmd = select_command(tx.commands, Fix)
         with require_that() as req:
@@ -64,6 +69,15 @@ class FixableDealContract(Contract):
                     and fix_cmd.value.of == before.fix_of)
                 req("terms other than the fixed value are unchanged",
                     replace(after, fixed_value=None) == before)
+                # Signer rule: both parties AND the oracle must be declared
+                # Fix-command signers — listing the oracle makes must_sign
+                # demand its transaction signature, so a unilateral
+                # fabricated rate cannot commit.
+                req("both parties sign the fixing",
+                    before.party_a.owning_key in fix_cmd.signers
+                    and before.party_b.owning_key in fix_cmd.signers)
+                req("the oracle attests the fixing",
+                    before.oracle.owning_key in fix_cmd.signers)
 
     @property
     def legal_contract_reference(self) -> SecureHash:
@@ -141,7 +155,8 @@ class FixingFlow(FlowLogic):
         tx = TransactionBuilder(notary=sar.state.notary)
         tx.add_input_state(sar)
         tx.add_output_state(replace(deal, fixed_value=fix.value))
-        tx.add_command(Command(fix, (me.owning_key, other.owning_key)))
+        tx.add_command(Command(fix, (me.owning_key, other.owning_key,
+                                     deal.oracle.owning_key)))
         tx.sign_with(self.service_hub.legal_identity_key)
         ptx = tx.to_signed_transaction(check_sufficient_signatures=False)
 
@@ -151,7 +166,8 @@ class FixingFlow(FlowLogic):
 
         response = yield self.send_and_receive(other, ptx, object)
         their_sig = response.unwrap(
-            lambda s: self._check_sig(s, ptx, other))
+            lambda s: self.check_counterparty_signature(
+                s, ptx.id.bytes, other))
         stx = ptx.with_additional_signature(their_sig)
         final = yield from self.sub_flow(
             FinalityFlow(stx, (me, other)))
@@ -165,19 +181,6 @@ class FixingFlow(FlowLogic):
 
         return StateAndRef(state, self.state_ref)
 
-    @staticmethod
-    def _check_sig(sig, ptx, counterparty):
-        from ..crypto.keys import DigitalSignature
-
-        if not isinstance(sig, DigitalSignature.WithKey):
-            raise FlowException("expected the counterparty's signature")
-        if sig.by not in counterparty.owning_key.keys:
-            # It must be THEIR signature — any other valid sig (ours, the
-            # oracle's) would only fail post-notarisation as SignersMissing.
-            raise FlowException(
-                f"signature is not by the counterparty {counterparty}")
-        sig.verify(ptx.id.bytes)
-        return sig
 
 
 @register_flow

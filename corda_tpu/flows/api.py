@@ -236,6 +236,22 @@ class FlowLogic:
         """Suspend on an async node service; see ServiceRequest."""
         return ServiceRequest(start)
 
+    @staticmethod
+    def check_counterparty_signature(sig, content: bytes, counterparty: Party):
+        """Shared validator for a returned co-signature: it must be a real
+        signature, BY the counterparty's key, over `content` — any other
+        valid signature would only fail much later (post-notarisation) as
+        missing signatures."""
+        from ..crypto.keys import DigitalSignature
+
+        if not isinstance(sig, DigitalSignature.WithKey):
+            raise FlowException("expected the counterparty's signature")
+        if sig.by not in counterparty.owning_key.keys:
+            raise FlowException(
+                f"signature is not by the counterparty {counterparty}")
+        sig.verify(content)
+        return sig
+
     def sub_flow(
         self, flow: "FlowLogic", share_parent_sessions: bool = False
     ) -> Generator:

@@ -55,23 +55,13 @@ class DealInstigatorFlow(FlowLogic):
         response = yield self.send_and_receive(
             self.other_party, DealHandshake(ptx), DigitalSignature.WithKey)
         sig = response.unwrap(
-            lambda s: self._check(s, ptx, self.other_party))
+            lambda s: self.check_counterparty_signature(
+                s, ptx.id.bytes, self.other_party))
         stx = ptx.with_additional_signature(sig)
         final = yield from self.sub_flow(FinalityFlow(
             stx, (self.service_hub.my_identity, self.other_party)))
         return final
 
-    @staticmethod
-    def _check(sig, ptx, counterparty):
-        if not isinstance(sig, DigitalSignature.WithKey):
-            raise FlowException("expected a signature")
-        if sig.by not in counterparty.owning_key.keys:
-            # Any valid signature is not enough: it must be THEIRS, or the
-            # failure surfaces post-notarisation as missing signatures.
-            raise FlowException(
-                f"signature is not by the counterparty {counterparty}")
-        sig.verify(ptx.id.bytes)
-        return sig
 
 
 @register_flow

@@ -189,6 +189,9 @@ class Node:
             self.smm, self.services.vault_service)
         CashBalanceMetricsObserver(self.services.vault_service,
                                    self.smm.metrics)
+        from .services.schema import SchemaObserver
+
+        self.schema = SchemaObserver(self.services.vault_service, self.db)
 
         # -- network map directory service (wire tier) ---------------------
         self.netmap_service = None

@@ -105,3 +105,43 @@ def test_scheduled_fixing_end_to_end(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_unilateral_fixing_rejected_at_contract_level():
+    """Regression: the ledger rule itself (not just the honest flows) must
+    reject a fixing that lacks the counterparty's or the oracle's declared
+    signature — otherwise one party could commit a fabricated rate."""
+    from dataclasses import replace
+
+    from corda_tpu.contracts.verification import ContractRejection
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.crypto.party import Party
+    from corda_tpu.finance.fixable_deal import FixableDealState
+    from corda_tpu.flows.oracle import Fix
+    from corda_tpu.testing.ledger_dsl import ledger
+    from corda_tpu.testing.dummies import DummyContract  # noqa: F401
+
+    a = Party.of("A", KeyPair.generate(b"\x91" * 32).public)
+    b = Party.of("B", KeyPair.generate(b"\x92" * 32).public)
+    o = Party.of("O", KeyPair.generate(b"\x93" * 32).public)
+    n = Party.of("N", KeyPair.generate(b"\x94" * 32).public)
+    deal = FixableDealState(party_a=a, party_b=b, oracle=o,
+                            fix_of=LIBOR_3M, fix_at_micros=1, notional=5)
+
+    l = ledger(n)
+    with l.transaction() as tx:  # only A signs: rejected
+        tx.input(deal)
+        tx.output(replace(deal, fixed_value=999_999))
+        tx.command(Fix(LIBOR_3M, 999_999), a.owning_key)
+        tx.fails_with("both parties sign")
+    with l.transaction() as tx:  # A+B but no oracle: rejected
+        tx.input(deal)
+        tx.output(replace(deal, fixed_value=999_999))
+        tx.command(Fix(LIBOR_3M, 999_999), a.owning_key, b.owning_key)
+        tx.fails_with("oracle attests")
+    with l.transaction() as tx:  # full signer set: accepted
+        tx.input(deal)
+        tx.output(replace(deal, fixed_value=RATE))
+        tx.command(Fix(LIBOR_3M, RATE), a.owning_key, b.owning_key,
+                   o.owning_key)
+        tx.verifies()

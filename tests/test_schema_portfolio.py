@@ -1,0 +1,174 @@
+"""Schema projections, the SVG visualiser, and simm-lite valuation.
+
+Mirrors the reference's HibernateObserver/CashSchemaV1 coverage (reference:
+node/.../schema/HibernateObserver.kt:28, finance/.../schemas/CashSchemaV1.kt),
+network-visualiser output, and the simm-valuation-demo protocol shape
+(samples/simm-valuation-demo/.../flows/SimmFlow.kt).
+"""
+
+import pytest
+
+from corda_tpu.crypto.provider import CpuVerifier
+from corda_tpu.finance import Amount, Cash
+from corda_tpu.node.config import NodeConfig
+from corda_tpu.node.node import Node
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+class TestSchemaProjection:
+    def test_cash_projects_and_marks_consumed(self, tmp_path):
+        node = Node(NodeConfig(name="S", base_dir=tmp_path / "S",
+                               network_map=tmp_path / "m.json")).start()
+        try:
+            issue = Cash.generate_issue(
+                Amount(5000, "USD"), node.identity.ref(b"\x01"),
+                node.identity.owning_key, node.identity)
+            issue.sign_with(node.key)
+            issue_stx = issue.to_signed_transaction()
+            node.services.record_transactions([issue_stx])
+
+            rows = node.schema.query("cash_states")
+            assert len(rows) == 1
+            assert rows[0]["currency"] == "USD"
+            assert rows[0]["quantity"] == 5000
+            assert rows[0]["consumed"] == 0
+
+            # Spend it: the projection row flips to consumed, change appears.
+            from corda_tpu.finance import CashState
+            from corda_tpu.transactions.builder import TransactionBuilder
+
+            tx = TransactionBuilder(notary=node.identity)
+            Cash.generate_spend(
+                tx, Amount(2000, "USD"), node.identity.owning_key,
+                node.services.vault_service.unconsumed_states(CashState))
+            tx.sign_with(node.key)
+            node.services.record_transactions(
+                [tx.to_signed_transaction(check_sufficient_signatures=False)])
+
+            live = node.schema.query("cash_states", "consumed = 0")
+            assert sum(r["quantity"] for r in live) == 5000
+            spent = node.schema.query("cash_states", "consumed = 1")
+            assert len(spent) == 1 and spent[0]["quantity"] == 5000
+
+            # SQL-side filtering works (the operational-query point).
+            big = node.schema.query(
+                "cash_states", "consumed = 0 AND quantity >= ?", (2500,))
+            assert len(big) == 1
+        finally:
+            node.stop()
+
+    def test_projection_rebuilds_after_restart(self, tmp_path):
+        node = Node(NodeConfig(name="S2", base_dir=tmp_path / "S2",
+                               network_map=tmp_path / "m.json")).start()
+        issue = Cash.generate_issue(
+            Amount(77, "EUR"), node.identity.ref(b"\x01"),
+            node.identity.owning_key, node.identity)
+        issue.sign_with(node.key)
+        node.services.record_transactions([issue.to_signed_transaction()])
+        node.stop()
+        del node
+
+        reborn = Node(NodeConfig(name="S2", base_dir=tmp_path / "S2",
+                                 network_map=tmp_path / "m.json")).start()
+        try:
+            rows = reborn.schema.query("cash_states", "consumed = 0")
+            assert [r["quantity"] for r in rows] == [77]
+        finally:
+            reborn.stop()
+
+
+class TestVisualiser:
+    def test_svg_renders_simulation_feed(self, tmp_path):
+        from corda_tpu.testing.simulation import TradeSimulation
+        from corda_tpu.tools.visualiser import render_svg
+
+        sim = TradeSimulation()
+        try:
+            sim.run_trade(500)
+            out = tmp_path / "trade.svg"
+            svg = render_svg(sim.sent_messages, out)
+            assert out.exists()
+            assert svg.startswith("<svg")
+            assert "platform.session" in svg  # topic labels present
+            # One lifeline per participating node.
+            assert svg.count("font-weight='bold'") >= 3
+        finally:
+            sim.stop()
+
+
+class TestSimmValuation:
+    def test_both_sides_compute_and_agree(self):
+        from corda_tpu.contracts.structures import Command, now_micros
+        from corda_tpu.flows.oracle import FixOf, RateOracle
+        from corda_tpu.tools.portfolio import (
+            PortfolioState,
+            SimmValuationFlow,
+            ValueCommand,
+            compute_valuation,
+            install_simm_responder,
+        )
+        from corda_tpu.transactions.builder import TransactionBuilder
+
+        net = MockNetwork(verifier=CpuVerifier())
+        try:
+            notary = net.create_notary_node("Notary")
+            a = net.create_node("Dealer A")
+            b = net.create_node("Dealer B")
+            o = net.create_node("Oracle")
+            rate_ref = FixOf("IM-RATE", 20_200, "1D")
+            RateOracle(o.smm, o.key, {rate_ref: 2_5000})  # 2.5 scaled 1e4
+            install_simm_responder(b.smm)
+
+            portfolio = PortfolioState(
+                party_a=a.identity, party_b=b.identity, oracle=o.identity,
+                rate_ref=rate_ref, notionals=(1_000, -400, 250))
+            tx = TransactionBuilder(notary=notary.identity)
+            tx.add_output_state(portfolio)
+            tx.add_command(Command(ValueCommand(), (a.identity.owning_key,
+                                                    b.identity.owning_key)))
+            tx.sign_with(a.key)
+            tx.sign_with(b.key)
+            stx = tx.to_signed_transaction()
+            a.record_transaction(stx)
+            b.record_transaction(stx)
+
+            handle = a.start_flow(SimmValuationFlow(stx.tx.out_ref(0).ref))
+            net.run_network()
+            final = handle.result.result()
+            valued = [s.data for s in final.tx.outputs
+                      if isinstance(s.data, PortfolioState)]
+            expected = compute_valuation((1_000, -400, 250), 2_5000)
+            assert valued[0].valuation == expected == 4125
+            # Both sides recorded the agreed valuation.
+            for node in (a, b):
+                assert node.services.storage_service.validated_transactions \
+                    .get_transaction(final.id) is not None
+        finally:
+            net.stop_nodes()
+
+
+def test_unilateral_valuation_rejected_at_contract_level():
+    """Regression: a valuation command missing a participant's declared
+    signature must fail contract verification."""
+    from dataclasses import replace
+
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.crypto.party import Party
+    from corda_tpu.flows.oracle import FixOf
+    from corda_tpu.testing.ledger_dsl import ledger
+    from corda_tpu.tools.portfolio import PortfolioState, ValueCommand
+
+    a = Party.of("A", KeyPair.generate(b"\x95" * 32).public)
+    b = Party.of("B", KeyPair.generate(b"\x96" * 32).public)
+    o = Party.of("O", KeyPair.generate(b"\x97" * 32).public)
+    n = Party.of("N", KeyPair.generate(b"\x98" * 32).public)
+    portfolio = PortfolioState(party_a=a, party_b=b, oracle=o,
+                               rate_ref=FixOf("R", 1, "1D"),
+                               notionals=(100,))
+
+    l = ledger(n)
+    with l.transaction() as tx:
+        tx.input(portfolio)
+        tx.output(replace(portfolio, valuation=1))
+        tx.command(ValueCommand(), a.owning_key)  # B never signs
+        tx.fails_with("both parties sign")
