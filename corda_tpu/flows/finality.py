@@ -14,6 +14,7 @@ from dataclasses import dataclass
 from ..crypto.party import Party
 from ..serialization.codec import register
 from ..transactions.signed import SignedTransaction
+from ..utils.progress import ProgressTracker, Step
 from .api import FlowLogic, register_flow
 from .notary import NotaryClientFlow
 
@@ -44,22 +45,34 @@ class BroadcastTransactionFlow(FlowLogic):
 
 @register_flow
 class FinalityFlow(FlowLogic):
-    """Notarise (if needed) then broadcast (FinalityFlow.kt:27-51)."""
+    """Notarise (if needed) then broadcast (FinalityFlow.kt:27-51).
+
+    Progress mirrors the reference's NOTARISING/BROADCASTING tracker, with
+    the notary sub-flow's own steps spliced beneath NOTARISING."""
 
     def __init__(self, transaction: SignedTransaction, participants: tuple):
         self.transaction = transaction
         self.participants = tuple(participants)
+        self.NOTARISING = Step("Requesting signature by notary service")
+        self.BROADCASTING = Step("Broadcasting transaction to participants")
+        self.progress_tracker = ProgressTracker(
+            self.NOTARISING, self.BROADCASTING)
 
     def call(self):
         stx = self.transaction
         if self._needs_notary_signature(stx):
-            notary_sig = yield from self.sub_flow(NotaryClientFlow(stx))
+            self.progress_tracker.current_step = self.NOTARISING
+            notary_flow = NotaryClientFlow(stx)
+            self.progress_tracker.set_child_tracker(
+                self.NOTARISING, notary_flow.progress_tracker)
+            notary_sig = yield from self.sub_flow(notary_flow)
             stx = stx.with_additional_signature(notary_sig)
+        self.progress_tracker.current_step = self.BROADCASTING
         yield from self.sub_flow(
             BroadcastTransactionFlow(stx, self.participants),
             share_parent_sessions=True,
         )
-        return stx
+        return stx  # the framework marks the tracker Done on completion
 
     @staticmethod
     def _needs_notary_signature(stx: SignedTransaction) -> bool:

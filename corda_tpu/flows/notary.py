@@ -26,6 +26,7 @@ from ..crypto.party import Party
 from ..crypto.signed_data import SignedData
 from ..serialization.codec import register
 from ..transactions.signed import SignaturesMissingException, SignedTransaction
+from ..utils.progress import ProgressTracker, Step
 from .api import FlowException, FlowLogic, FlowSessionException, register_flow
 
 
@@ -120,10 +121,18 @@ class NotaryException(FlowException):
 
 @register_flow
 class NotaryClientFlow(FlowLogic):
-    """Obtain the notary's uniqueness signature over a transaction."""
+    """Obtain the notary's uniqueness signature over a transaction.
+
+    Progress steps mirror the reference's NotaryFlow tracker
+    (NotaryFlow.kt REQUESTING/VALIDATING)."""
 
     def __init__(self, stx: SignedTransaction):
         self.stx = stx
+        self.VERIFYING = Step("Verifying our signatures")
+        self.REQUESTING = Step("Requesting signature by notary service")
+        self.VALIDATING = Step("Validating response from notary service")
+        self.progress_tracker = ProgressTracker(
+            self.VERIFYING, self.REQUESTING, self.VALIDATING)
 
     def call(self):
         wtx = self.stx.tx
@@ -136,6 +145,7 @@ class NotaryClientFlow(FlowLogic):
                 raise FlowException("Input states must have the same Notary")
         # Check our own signature set (batched with everything else pending
         # on this node); the notary's signature is the one allowed missing.
+        self.progress_tracker.current_step = self.VERIFYING
         try:
             yield self.verify_signatures_batched(self.stx, notary_party.owning_key)
         except SignatureError as e:
@@ -143,8 +153,10 @@ class NotaryClientFlow(FlowLogic):
                 NotarySignaturesMissing(frozenset(self.stx.get_missing_signatures()))
             ) from e
 
+        self.progress_tracker.current_step = self.REQUESTING
         request = SignRequest(self.stx, self.service_hub.my_identity)
         response = yield self.send_and_receive(notary_party, request)
+        self.progress_tracker.current_step = self.VALIDATING
         result = response.unwrap()
 
         if isinstance(result, NotarySuccess):

@@ -623,14 +623,27 @@ class FlowStateMachine:
 
     def _finish(self, result) -> None:
         self.state = _DONE
+        self._progress_done()
         self.manager._flow_finished(self)
         self.future.set_result(result)
 
     def _fail(self, exc: BaseException) -> None:
         self.state = _DONE
+        self._progress_done()
         logger.debug("flow %s failed: %s", self.run_id.hex()[:8], exc)
         self.manager._flow_finished(self)
         self.future.set_exception(exc)
+
+    def _progress_done(self) -> None:
+        """The framework, not each flow, marks trackers Done on completion —
+        success or failure — so observers never see a finished flow stuck on
+        its last step."""
+        tracker = self.logic.progress_tracker
+        if tracker is not None:
+            from ..utils.progress import DONE
+
+            if tracker.current_step != DONE:
+                tracker.current_step = DONE
 
     def to_checkpoint(self) -> Checkpoint:
         return Checkpoint(
@@ -745,17 +758,22 @@ class StateMachineManager:
         fsm = FlowStateMachine(self, logic, run_id)
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
-        if logic.progress_tracker is not None:
-            # Surface step changes on the manager's change feed (the
-            # reference streams these to RPC, CordaRPCOps.kt:66-67).
-            logic.progress_tracker.subscribe(
-                lambda change, rid=run_id:
-                self.changes.append(("progress", rid, change.path)))
+        self._subscribe_progress(logic, run_id)
         self._checkpoint(fsm)
         self._mark_runnable(fsm)
         self.changes.append(("add", run_id))
         self._pump()
         return FlowHandle(run_id, fsm.future, logic)
+
+    def _subscribe_progress(self, logic: FlowLogic, run_id: bytes) -> None:
+        """Surface a flow's tracker steps on the manager's change feed (the
+        reference streams these to RPC, CordaRPCOps.kt:66-67). Called at
+        EVERY flow-creation site — add(), session-initiated factories and
+        checkpoint restore — so restored flows keep reporting."""
+        if logic.progress_tracker is not None:
+            logic.progress_tracker.subscribe(
+                lambda change, rid=run_id:
+                self.changes.append(("progress", rid, change.path)))
 
     @property
     def in_flight_count(self) -> int:
@@ -788,6 +806,7 @@ class StateMachineManager:
                 continue
             restored = [FlowSession.from_checkpoint(sc) for sc in cp.sessions]
             sessions = {s.key: s for s in restored}
+            self._subscribe_progress(logic, cp.run_id)
             fsm = FlowStateMachine(
                 self,
                 logic,
@@ -983,6 +1002,7 @@ class StateMachineManager:
             return
         logic = factory(initiator)
         run_id = os.urandom(16)
+        self._subscribe_progress(logic, run_id)
         fsm = FlowStateMachine(self, logic, run_id)
         self.flows[run_id] = fsm
         self.metrics["started"] += 1

@@ -335,3 +335,33 @@ def test_netmap_service_register_fetch_subscribe(tmp_path):
     finally:
         for n in nodes:
             n.stop()
+
+
+def test_notarisation_emits_progress_events(tmp_path):
+    """The library flows declare real progress steps: a notarisation's
+    change feed shows the NotaryClientFlow tracker advancing (the stream the
+    reference renders over RPC/console)."""
+    from corda_tpu.flows.notary import NotaryClientFlow
+    from test_tcp_node import issue_and_move, pump_until
+
+    notary = Node(NodeConfig(name="Notary", base_dir=tmp_path / "Notary",
+                             notary="simple",
+                             network_map=tmp_path / "m.json")).start()
+    alice = Node(NodeConfig(name="Alice", base_dir=tmp_path / "Alice",
+                            network_map=tmp_path / "m.json")).start()
+    try:
+        for n in (notary, alice):
+            n.refresh_netmap()
+        stx = issue_and_move(alice, notary.identity, magic=33)
+        h = alice.start_flow(NotaryClientFlow(stx))
+        pump_until([notary, alice], lambda: h.result.done)
+        h.result.result()
+        _cursor, events = alice.smm.changes.since(0)
+        paths = [e[2] for e in events if e[0] == "progress"]
+        labels = [p[-1] for p in paths]
+        assert "Verifying our signatures" in labels
+        assert "Requesting signature by notary service" in labels
+        assert "Validating response from notary service" in labels
+    finally:
+        notary.stop()
+        alice.stop()
