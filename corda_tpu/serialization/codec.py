@@ -223,7 +223,12 @@ def _encode(out: bytearray, value: Any) -> None:
             _encode(out, f)
 
 
-def _decode(data: bytes, pos: int) -> tuple[Any, int]:
+_MAX_DEPTH = 64  # hostile nesting must exhaust this, not the Python stack
+
+
+def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
+    if depth > _MAX_DEPTH:
+        raise DeserializationError("nesting too deep")
     if pos >= len(data):
         raise DeserializationError("truncated data")
     tag = data[pos]
@@ -246,23 +251,30 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         n, pos = _read_varint(data, pos)
         if pos + n > len(data):
             raise DeserializationError("truncated string")
-        return data[pos : pos + n].decode("utf-8"), pos + n
+        try:
+            return data[pos : pos + n].decode("utf-8"), pos + n
+        except UnicodeDecodeError as e:
+            raise DeserializationError(f"invalid utf-8 string: {e}") from e
     if tag == _TAG_LIST:
         n, pos = _read_varint(data, pos)
+        if n > len(data) - pos:  # every item needs >= 1 byte: cheap DoS gate
+            raise DeserializationError("collection count exceeds data")
         items = []
         for _ in range(n):
-            item, pos = _decode(data, pos)
+            item, pos = _decode(data, pos, depth + 1)
             items.append(item)
         return tuple(items), pos
     if tag == _TAG_DICT:
         n, pos = _read_varint(data, pos)
+        if n > len(data) - pos:
+            raise DeserializationError("collection count exceeds data")
         d = {}
         prev_kenc: bytes | None = None
         for _ in range(n):
             kstart = pos
-            k, pos = _decode(data, pos)
+            k, pos = _decode(data, pos, depth + 1)
             kenc = data[kstart:pos]
-            v, pos = _decode(data, pos)
+            v, pos = _decode(data, pos, depth + 1)
             # Canonicality: KEY encodings must arrive strictly increasing —
             # strictness on the key alone also rejects duplicate keys (a
             # duplicate with a larger value encoding would otherwise pass a
@@ -275,11 +287,13 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         return d, pos
     if tag == _TAG_FROZENSET:
         n, pos = _read_varint(data, pos)
+        if n > len(data) - pos:
+            raise DeserializationError("collection count exceeds data")
         items = []
         prev_enc: bytes | None = None
         for _ in range(n):
             start = pos
-            item, pos = _decode(data, pos)
+            item, pos = _decode(data, pos, depth + 1)
             enc = data[start:pos]
             if prev_enc is not None and enc <= prev_enc:
                 raise DeserializationError("non-canonical frozenset order")
@@ -288,7 +302,12 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         return frozenset(items), pos
     if tag == _TAG_OBJECT:
         n, pos = _read_varint(data, pos)
-        wire_name = data[pos : pos + n].decode("utf-8")
+        if pos + n > len(data):
+            raise DeserializationError("truncated wire name")
+        try:
+            wire_name = data[pos : pos + n].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise DeserializationError(f"invalid wire name: {e}") from e
         pos += n
         if wire_name == "__svc_token__":
             from .tokens import current_token_context
@@ -296,7 +315,11 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
             nfields, pos = _read_varint(data, pos)
             if nfields != 1:
                 raise DeserializationError("malformed service token")
-            token_name, pos = _decode(data, pos)
+            token_name, pos = _decode(data, pos, depth + 1)
+            if not isinstance(token_name, str):
+                # An unhashable/wrong-typed name must reject, not TypeError
+                # out of the registry lookup.
+                raise DeserializationError("service token name must be a string")
             ctx = current_token_context()
             if ctx is None:
                 raise DeserializationError(
@@ -312,11 +335,15 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         nfields, pos = _read_varint(data, pos)
         values = []
         for _ in range(nfields):
-            v, pos = _decode(data, pos)
+            v, pos = _decode(data, pos, depth + 1)
             values.append(v)
         dec = _CUSTOM_DEC.get(wire_name)
         if dec is not None:
-            return dec(tuple(values)), pos
+            try:
+                return dec(tuple(values)), pos
+            except Exception as e:  # malformed payloads must not crash callers
+                raise DeserializationError(
+                    f"cannot decode {wire_name}: {e}") from e
         flds = dataclasses.fields(cls)
         if len(values) != len(flds):
             raise DeserializationError(

@@ -168,3 +168,65 @@ def test_decoder_rejects_duplicate_key_with_differing_values():
     crafted = bytes.fromhex("070203020501610302050162")
     with pytest.raises(DeserializationError):
         deserialize(crafted)
+
+
+def test_decoder_never_crashes_on_fuzzed_bytes():
+    """Hostile-input property: arbitrary bytes either decode or raise
+    DeserializationError — no other exception type, no hang (the codec is a
+    wire surface; reference relies on controlled Kryo registration for the
+    same guarantee)."""
+    import random
+
+    from corda_tpu.serialization.codec import (
+        DeserializationError, deserialize, serialize,
+    )
+
+    rng = random.Random(1337)
+    # Pure noise...
+    for _ in range(300):
+        blob = rng.randbytes(rng.randrange(0, 200))
+        try:
+            deserialize(blob)
+        except DeserializationError:
+            pass
+    # ...and mutated VALID encodings (more likely to reach deep paths).
+    from corda_tpu.crypto.hashes import SecureHash
+
+    seed_values = [
+        {"a": 1, "b": [1, 2, 3]},
+        (SecureHash.zero(), "text", b"bytes", frozenset([1, 2])),
+        [None, True, False, -12345678901234567890],
+    ]
+    for value in seed_values:
+        good = bytearray(serialize(value).bytes)
+        for _ in range(300):
+            blob = bytearray(good)
+            for _ in range(rng.randrange(1, 4)):
+                pos = rng.randrange(len(blob))
+                blob[pos] = rng.randrange(256)
+            try:
+                deserialize(bytes(blob))
+            except DeserializationError:
+                pass
+
+
+def test_decoder_rejects_hostile_structures():
+    """Regressions from fuzz review: deep nesting, bad token names, and
+    failing custom decoders all surface as DeserializationError."""
+    import pytest
+
+    from corda_tpu.serialization.codec import (
+        DeserializationError, deserialize,
+    )
+
+    # 5000-deep nested lists: bounded rejection, not RecursionError.
+    with pytest.raises(DeserializationError, match="nesting too deep"):
+        deserialize(b"\x06\x01" * 5000 + b"\x00")
+
+    # Service token whose "name" is a dict: rejected inside a TokenContext.
+    from corda_tpu.serialization.tokens import TokenContext
+
+    blob = bytes([0x08, 13]) + b"__svc_token__" + bytes([0x01, 0x07, 0x00])
+    with TokenContext():
+        with pytest.raises(DeserializationError, match="must be a string"):
+            deserialize(blob)
