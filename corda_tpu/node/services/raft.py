@@ -130,6 +130,28 @@ class ClientReply:
     leader_hint: str | None
 
 
+@register
+@dataclass(frozen=True)
+class InstallSnapshot:
+    """Leader -> lagging follower: the full state-machine content replaces
+    the follower's, when the leader's log was compacted past the follower's
+    position (DistributedImmutableMap.kt snapshot/install capability)."""
+
+    term: int
+    leader: str
+    last_included_index: int
+    last_included_term: int
+    entries: tuple  # ((state_ref, ConsumingTx), ...) — the committed map
+
+
+@register
+@dataclass(frozen=True)
+class InstallSnapshotReply:
+    term: int
+    follower: str
+    last_included_index: int
+
+
 class RaftMember:
     """One member of the notary cluster's consensus group."""
 
@@ -173,10 +195,15 @@ class RaftMember:
         self.leader_name: str | None = None
         self.commit_index = int(db.get_setting("raft_commit_index") or 0)
         self.last_applied = int(db.get_setting("raft_last_applied") or 0)
+        # Log compaction marker: entries <= snapshot_index live only in the
+        # applied state machine (committed_states), not the log.
+        self.snapshot_index = int(db.get_setting("raft_snapshot_index") or 0)
+        self.snapshot_term = int(db.get_setting("raft_snapshot_term") or 0)
         self._votes: set[str] = set()
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._last_heartbeat = self.clock()
+        self._snapshot_sent_at: dict[str, float] = {}
         self._election_deadline = self._next_election_deadline()
         # request_id -> ClientReply for commits decided at this member.
         # Bounded: late/duplicate replies for abandoned requests must not
@@ -202,11 +229,14 @@ class RaftMember:
         row = self.db.conn.execute(
             "SELECT idx, term FROM raft_log ORDER BY idx DESC LIMIT 1"
         ).fetchone()
-        return (row[0], row[1]) if row else (0, 0)
+        return (row[0], row[1]) if row else (self.snapshot_index,
+                                             self.snapshot_term)
 
     def _log_term_at(self, idx: int) -> int | None:
         if idx == 0:
             return 0
+        if idx == self.snapshot_index:
+            return self.snapshot_term
         row = self.db.conn.execute(
             "SELECT term FROM raft_log WHERE idx=?", (idx,)).fetchone()
         return None if row is None else row[0]
@@ -323,6 +353,17 @@ class RaftMember:
             self._on_client_commit(payload)
         elif isinstance(payload, ClientReply):
             self._record_decision(payload.request_id, payload)
+        elif isinstance(payload, InstallSnapshot):
+            self._on_install_snapshot(payload, message.sender)
+        elif isinstance(payload, InstallSnapshotReply):
+            if payload.term > self.term:
+                self._become_follower(payload.term)
+            elif self.role == "leader":
+                self._match_index[payload.follower] = max(
+                    self._match_index.get(payload.follower, 0),
+                    payload.last_included_index)
+                self._next_index[payload.follower] = \
+                    payload.last_included_index + 1
 
     def _on_request_vote(self, rv: RequestVote, sender) -> None:
         if rv.term > self.term:
@@ -347,10 +388,26 @@ class RaftMember:
             self._votes.add(vr.voter)
             self._maybe_win()
 
+    COMPACT_THRESHOLD = 256  # log entries kept before compacting applied ones
+
     def _broadcast_append(self) -> None:
         self._last_heartbeat = self.clock()
         for peer_name, addr in self.peers.items():
             nxt = self._next_index.get(peer_name, 1)
+            if nxt <= self.snapshot_index:
+                # The entries this peer needs were compacted away: ship the
+                # whole applied state instead (DistributedImmutableMap
+                # snapshot/install capability). Throttled — a snapshot is
+                # O(map) to read+serialize, so don't re-send every heartbeat
+                # while one is already in flight.
+                now = self.clock()
+                sent_at = self._snapshot_sent_at.get(peer_name, 0.0)
+                if now - sent_at >= 10 * self.HEARTBEAT * self.scale:
+                    self._snapshot_sent_at[peer_name] = now
+                    self._send(addr, InstallSnapshot(
+                        self.term, self.name, self.snapshot_index,
+                        self.snapshot_term, self._state_machine_content()))
+                continue
             prev_idx = nxt - 1
             prev_term = self._log_term_at(prev_idx) or 0
             entries = tuple(
@@ -358,6 +415,80 @@ class RaftMember:
             self._send(addr, AppendEntries(
                 self.term, self.name, prev_idx, prev_term, entries,
                 self.commit_index))
+
+    def _state_machine_content(self) -> tuple:
+        rows = self.db.conn.execute(
+            "SELECT state_ref, consuming FROM committed_states").fetchall()
+        return tuple((bytes(r[0]), bytes(r[1])) for r in rows)
+
+    def maybe_compact(self) -> None:
+        """Drop applied log entries once the log outgrows the threshold —
+        their effects live durably in committed_states; lagging peers get an
+        InstallSnapshot instead of replay."""
+        (log_len,) = self.db.conn.execute(
+            "SELECT COUNT(*) FROM raft_log").fetchone()
+        if log_len <= self.COMPACT_THRESHOLD:
+            return
+        upto = self.last_applied
+        if self.role == "leader" and self._match_index:
+            # Keep what live followers still need: a follower one entry
+            # behind should get AppendEntries, not a full snapshot.
+            upto = min(upto, min(self._match_index.values()))
+        if upto <= self.snapshot_index:
+            return
+        term = self._log_term_at(upto)
+        if term is None:
+            return
+        with self.db.lock:
+            # Log prefix deletion and the snapshot marker must be ONE
+            # transaction: a crash between them would leave a log whose
+            # indices silently rebase to 1 — replicated-log corruption.
+            self.db.conn.execute(
+                "DELETE FROM raft_log WHERE idx <= ?", (upto,))
+            for key, value in (("raft_snapshot_index", str(upto)),
+                               ("raft_snapshot_term", str(term))):
+                self.db.conn.execute(
+                    "INSERT OR REPLACE INTO settings (key, value) "
+                    "VALUES (?, ?)", (key, value))
+            self.db.conn.commit()
+        self.snapshot_index, self.snapshot_term = upto, term
+
+    def _on_install_snapshot(self, snap: InstallSnapshot, sender) -> None:
+        if snap.term < self.term:
+            # Reply with our term so a deposed leader steps down instead of
+            # re-sending the snapshot every heartbeat forever.
+            self._send(sender, InstallSnapshotReply(self.term, self.name, 0))
+            return
+        self._become_follower(snap.term, leader=snap.leader)
+        if snap.last_included_index > self.last_applied:
+            new_commit = max(self.commit_index, snap.last_included_index)
+            with self.db.lock:
+                # State replacement + markers in ONE transaction (crash
+                # between them would desync applied state from the log view).
+                self.db.conn.execute("DELETE FROM committed_states")
+                self.db.conn.executemany(
+                    "INSERT OR REPLACE INTO committed_states "
+                    "(state_ref, consuming) VALUES (?, ?)",
+                    list(snap.entries))
+                self.db.conn.execute("DELETE FROM raft_log")
+                for key, value in (
+                        ("raft_snapshot_index",
+                         str(snap.last_included_index)),
+                        ("raft_snapshot_term",
+                         str(snap.last_included_term)),
+                        ("raft_commit_index", str(new_commit)),
+                        ("raft_last_applied",
+                         str(snap.last_included_index))):
+                    self.db.conn.execute(
+                        "INSERT OR REPLACE INTO settings (key, value) "
+                        "VALUES (?, ?)", (key, value))
+                self.db.conn.commit()
+            self.last_applied = snap.last_included_index
+            self.commit_index = new_commit
+            self.snapshot_index = snap.last_included_index
+            self.snapshot_term = snap.last_included_term
+        self._send(sender, InstallSnapshotReply(
+            self.term, self.name, snap.last_included_index))
 
     def _on_append(self, ae: AppendEntries, sender) -> None:
         if ae.term < self.term:
@@ -453,6 +584,7 @@ class RaftMember:
         if applied_any:  # no idle-heartbeat sqlite churn
             self.db.set_setting("raft_commit_index", str(self.commit_index))
             self.db.set_setting("raft_last_applied", str(self.last_applied))
+            self.maybe_compact()
 
 
 from ...utils.excheckpoint import register_flow_exception

@@ -283,7 +283,10 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
             if prev_kenc is not None and kenc <= prev_kenc:
                 raise DeserializationError("non-canonical dict entry order")
             prev_kenc = kenc
-            d[k] = v
+            try:
+                d[k] = v
+            except TypeError as e:  # unhashable key (e.g. a dict)
+                raise DeserializationError(f"unhashable dict key: {e}") from e
         return d, pos
     if tag == _TAG_FROZENSET:
         n, pos = _read_varint(data, pos)
@@ -299,7 +302,10 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
                 raise DeserializationError("non-canonical frozenset order")
             prev_enc = enc
             items.append(item)
-        return frozenset(items), pos
+        try:
+            return frozenset(items), pos
+        except TypeError as e:  # unhashable member (e.g. a dict)
+            raise DeserializationError(f"unhashable set member: {e}") from e
     if tag == _TAG_OBJECT:
         n, pos = _read_varint(data, pos)
         if pos + n > len(data):

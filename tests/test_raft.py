@@ -157,3 +157,71 @@ def test_leader_kill_cluster_regroups_and_commits(tmp_path):
     finally:
         for n in survivors:
             n.stop()
+
+
+def test_log_compaction_and_snapshot_install(tmp_path, monkeypatch):
+    """DistributedImmutableMap snapshot/install capability: after the log is
+    compacted, a member that LOST ITS DISK rejoins via a state snapshot from
+    the leader — not log replay — and converges to the same committed map."""
+    from corda_tpu.node.services.raft import RaftMember
+
+    monkeypatch.setattr(RaftMember, "COMPACT_THRESHOLD", 8)
+    nodes = make_cluster(tmp_path)
+    alice = Node(NodeConfig(name="Alice", base_dir=tmp_path / "Alice",
+                            network_map=tmp_path / "netmap.json")).start()
+    everyone = nodes + [alice]
+    try:
+        leader = wait_for_leader(nodes)
+        for n in everyone:
+            n.refresh_netmap()
+
+        # Enough commits to trip compaction on every member.
+        for i in range(20):
+            stx = issue_and_move(alice, leader.identity, magic=100 + i)
+            h = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(everyone, lambda: h.result.done)
+            h.result.result()
+        pump_until(everyone, lambda: all(
+            n.uniqueness_provider.committed_count == 20 for n in nodes))
+        pump_until(everyone, lambda: all(
+            n.raft_member.snapshot_index > 0 for n in nodes), timeout=20.0)
+        for n in nodes:
+            (log_len,) = n.db.conn.execute(
+                "SELECT COUNT(*) FROM raft_log").fetchone()
+            assert log_len <= 8 + 2  # compacted
+
+        # Disaster: one FOLLOWER loses its entire disk.
+        leader = wait_for_leader(nodes)
+        victim = next(n for n in nodes if n.raft_member.role != "leader")
+        name = victim.config.name
+        victim.stop()
+        nodes.remove(victim)
+        everyone.remove(victim)
+        import shutil
+
+        shutil.rmtree(tmp_path / name)  # nothing left to replay from
+
+        reborn = Node(NodeConfig(
+            name=name, base_dir=tmp_path / name, notary="raft-simple",
+            raft_cluster=CLUSTER,
+            network_map=tmp_path / "netmap.json")).start()
+        nodes.append(reborn)
+        everyone.append(reborn)
+        for n in everyone:
+            n.refresh_netmap()
+        # The leader's log no longer reaches index 1: only an InstallSnapshot
+        # can catch the blank member up.
+        pump_until(everyone, lambda:
+                   reborn.uniqueness_provider.committed_count == 20,
+                   timeout=25.0)
+        assert reborn.raft_member.snapshot_index >= \
+            min(n.raft_member.snapshot_index for n in nodes if n is not reborn)
+
+        # And the cluster still commits new transactions afterwards.
+        stx = issue_and_move(alice, leader.identity, magic=999)
+        h = alice.start_flow(NotaryClientFlow(stx))
+        pump_until(everyone, lambda: h.result.done, timeout=20.0)
+        h.result.result()
+    finally:
+        for n in everyone:
+            n.stop()

@@ -230,3 +230,13 @@ def test_decoder_rejects_hostile_structures():
     with TokenContext():
         with pytest.raises(DeserializationError, match="must be a string"):
             deserialize(blob)
+
+
+def test_decoder_rejects_unhashable_keys_and_members():
+    import pytest
+    from corda_tpu.serialization.codec import DeserializationError, deserialize
+
+    with pytest.raises(DeserializationError, match="unhashable dict key"):
+        deserialize(bytes([0x07, 0x01, 0x07, 0x00, 0x00]))  # dict key = dict
+    with pytest.raises(DeserializationError, match="unhashable set member"):
+        deserialize(bytes([0x09, 0x01, 0x07, 0x00]))  # set member = dict
