@@ -273,6 +273,12 @@ class TcpMessaging(MessagingService):
         self._outbox.append(peer, unique_id, frame)
         self._ensure_bridge(peer)
 
+    def outbox_backlog(self, to) -> int:
+        """Undelivered (un-ACKed) frames queued for a peer — lets protocols
+        that generate large resendable payloads (raft snapshots) avoid
+        stuffing the durable outbox of an unreachable peer."""
+        return len(self._outbox.pending(str(to)))
+
     def _ensure_bridge(self, peer: str) -> None:
         with self._lock:
             ev = self._bridge_wakeups.setdefault(peer, threading.Event())
@@ -287,10 +293,15 @@ class TcpMessaging(MessagingService):
     def _bridge_loop(self, peer: str, wakeup: threading.Event) -> None:
         """Store-and-forward bridge: replay the peer's outbox until empty,
         deleting on ACK; reconnect with backoff forever while running."""
+        import sqlite3
+
         host, port_s = peer.rsplit(":", 1)
         attempt = 0
         while self._running:
-            pending = self._outbox.pending(peer)
+            try:
+                pending = self._outbox.pending(peer)
+            except sqlite3.ProgrammingError:
+                return  # db closed: the node is shutting down
             if not pending:
                 wakeup.clear()
                 wakeup.wait(timeout=1.0)
@@ -311,6 +322,8 @@ class TcpMessaging(MessagingService):
                     with contextlib.closing(sock):
                         attempt = 0
                         self._replay_outbox(peer, sock)
+            except sqlite3.ProgrammingError:
+                return  # db closed mid-replay: the node is shutting down
             except OSError:
                 backoff = self.RETRY_BACKOFF[
                     min(attempt, len(self.RETRY_BACKOFF) - 1)]

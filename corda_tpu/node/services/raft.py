@@ -110,6 +110,12 @@ class AppendReply:
     success: bool
     match_index: int
     follower: str
+    # On failure: the follower's last log/snapshot index (-1 = no hint), so
+    # the leader can jump next_index instead of decrementing one entry per
+    # round trip (and instead of livelocking against a follower whose
+    # snapshot is AHEAD of the leader's own compaction point). 0 is a REAL
+    # hint: an empty-log follower wants next_index = 1 immediately.
+    hint_index: int = -1
 
 
 @register
@@ -133,15 +139,20 @@ class ClientReply:
 @register
 @dataclass(frozen=True)
 class InstallSnapshot:
-    """Leader -> lagging follower: the full state-machine content replaces
-    the follower's, when the leader's log was compacted past the follower's
-    position (DistributedImmutableMap.kt snapshot/install capability)."""
+    """Leader -> lagging follower: the state-machine content replaces the
+    follower's, when the leader's log was compacted past the follower's
+    position (DistributedImmutableMap.kt snapshot/install capability).
+    CHUNKED: large maps ship as an ordered series of frames (each well under
+    the transport's frame cap); `offset` is the entry index of the first
+    entry in this chunk, `done` marks the last chunk."""
 
     term: int
     leader: str
     last_included_index: int
     last_included_term: int
-    entries: tuple  # ((state_ref, ConsumingTx), ...) — the committed map
+    entries: tuple  # ((state_ref_blob, consuming_blob), ...)
+    offset: int = 0
+    done: bool = True
 
 
 @register
@@ -359,11 +370,14 @@ class RaftMember:
             if payload.term > self.term:
                 self._become_follower(payload.term)
             elif self.role == "leader":
-                self._match_index[payload.follower] = max(
-                    self._match_index.get(payload.follower, 0),
-                    payload.last_included_index)
-                self._next_index[payload.follower] = \
-                    payload.last_included_index + 1
+                match = max(self._match_index.get(payload.follower, 0),
+                            payload.last_included_index)
+                self._match_index[payload.follower] = match
+                # Never move next_index BACKWARDS past what the follower
+                # already matched (a stale snapshot reply must not restart
+                # replication behind a fresher position).
+                self._next_index[payload.follower] = max(
+                    self._next_index.get(payload.follower, 1), match + 1)
 
     def _on_request_vote(self, rv: RequestVote, sender) -> None:
         if rv.term > self.term:
@@ -389,6 +403,7 @@ class RaftMember:
             self._maybe_win()
 
     COMPACT_THRESHOLD = 256  # log entries kept before compacting applied ones
+    SNAPSHOT_CHUNK = 10_000  # map entries per InstallSnapshot frame
 
     def _broadcast_append(self) -> None:
         self._last_heartbeat = self.clock()
@@ -396,17 +411,37 @@ class RaftMember:
             nxt = self._next_index.get(peer_name, 1)
             if nxt <= self.snapshot_index:
                 # The entries this peer needs were compacted away: ship the
-                # whole applied state instead (DistributedImmutableMap
+                # applied state instead (DistributedImmutableMap
                 # snapshot/install capability). Throttled — a snapshot is
                 # O(map) to read+serialize, so don't re-send every heartbeat
-                # while one is already in flight.
+                # while one is in flight — and CHUNKED so a large map never
+                # exceeds the transport frame cap.
                 now = self.clock()
                 sent_at = self._snapshot_sent_at.get(peer_name, 0.0)
-                if now - sent_at >= 10 * self.HEARTBEAT * self.scale:
+                backlog_fn = getattr(self.messaging, "outbox_backlog", None)
+                backlog = backlog_fn(addr) if backlog_fn is not None else 0
+                if (now - sent_at >= 10 * self.HEARTBEAT * self.scale
+                        and backlog <= 8):
+                    # Backlog gate: a live peer ACKs frames and stays near
+                    # zero; an unreachable one accumulates them, and its
+                    # durable outbox must NOT gain a superseded snapshot
+                    # series every throttle window.
                     self._snapshot_sent_at[peer_name] = now
-                    self._send(addr, InstallSnapshot(
-                        self.term, self.name, self.snapshot_index,
-                        self.snapshot_term, self._state_machine_content()))
+                    content = self._state_machine_content()
+                    for off in range(0, max(len(content), 1),
+                                     self.SNAPSHOT_CHUNK):
+                        chunk = content[off:off + self.SNAPSHOT_CHUNK]
+                        self._send(addr, InstallSnapshot(
+                            self.term, self.name, self.snapshot_index,
+                            self.snapshot_term, chunk, off,
+                            off + self.SNAPSHOT_CHUNK >= len(content)))
+                # Keep the follower's election timer fed between snapshot
+                # rounds with a prev=0 keepalive: index 0 exists on every
+                # member, so this ALWAYS succeeds (reply match=0, absorbed by
+                # the monotone success path) and never generates the failure
+                # churn an un-appendable heartbeat would.
+                self._send(addr, AppendEntries(
+                    self.term, self.name, 0, 0, (), self.commit_index))
                 continue
             prev_idx = nxt - 1
             prev_term = self._log_term_at(prev_idx) or 0
@@ -429,11 +464,13 @@ class RaftMember:
             "SELECT COUNT(*) FROM raft_log").fetchone()
         if log_len <= self.COMPACT_THRESHOLD:
             return
-        upto = self.last_applied
+        # Retain a tail so slightly-behind followers get AppendEntries, and
+        # respect follower match positions — but only down to a FLOOR: a
+        # dead peer must not pin the log forever (it will get a snapshot).
+        upto = self.last_applied - self.COMPACT_THRESHOLD // 2
         if self.role == "leader" and self._match_index:
-            # Keep what live followers still need: a follower one entry
-            # behind should get AppendEntries, not a full snapshot.
-            upto = min(upto, min(self._match_index.values()))
+            floor = self.last_applied - 4 * self.COMPACT_THRESHOLD
+            upto = min(upto, max(min(self._match_index.values()), floor))
         if upto <= self.snapshot_index:
             return
         term = self._log_term_at(upto)
@@ -460,6 +497,22 @@ class RaftMember:
             self._send(sender, InstallSnapshotReply(self.term, self.name, 0))
             return
         self._become_follower(snap.term, leader=snap.leader)
+        # Chunk assembly: chunks of one snapshot series arrive in order on
+        # the same bridge; offset 0 restarts staging, mismatched continuation
+        # discards (the leader re-sends the series on its throttle).
+        series_key = (snap.term, snap.last_included_index)
+        if snap.offset == 0:
+            self._snapshot_staging = (series_key, list(snap.entries))
+        else:
+            staged = getattr(self, "_snapshot_staging", None)
+            if staged is None or staged[0] != series_key \
+                    or len(staged[1]) != snap.offset:
+                return  # out-of-sequence chunk: wait for a fresh series
+            staged[1].extend(snap.entries)
+        if not snap.done:
+            return
+        entries = tuple(self._snapshot_staging[1])
+        self._snapshot_staging = None
         if snap.last_included_index > self.last_applied:
             new_commit = max(self.commit_index, snap.last_included_index)
             with self.db.lock:
@@ -469,7 +522,7 @@ class RaftMember:
                 self.db.conn.executemany(
                     "INSERT OR REPLACE INTO committed_states "
                     "(state_ref, consuming) VALUES (?, ?)",
-                    list(snap.entries))
+                    list(entries))
                 self.db.conn.execute("DELETE FROM raft_log")
                 for key, value in (
                         ("raft_snapshot_index",
@@ -497,7 +550,9 @@ class RaftMember:
         self._become_follower(ae.term, leader=ae.leader)
         local_prev = self._log_term_at(ae.prev_index)
         if local_prev is None or local_prev != ae.prev_term:
-            self._send(sender, AppendReply(self.term, False, 0, self.name))
+            self._send(sender, AppendReply(
+                self.term, False, 0, self.name,
+                hint_index=self._log_last()[0]))
             return
         idx = ae.prev_index
         for term, cmd in ae.entries:
@@ -521,13 +576,26 @@ class RaftMember:
         if self.role != "leader":
             return
         if ar.success:
-            self._match_index[ar.follower] = max(
-                self._match_index.get(ar.follower, 0), ar.match_index)
-            self._next_index[ar.follower] = ar.match_index + 1
+            # Monotone: a success for an EARLIER position (e.g. the prev=0
+            # keepalive heartbeat used during snapshot transfer) must not
+            # move match/next backwards.
+            match = max(self._match_index.get(ar.follower, 0), ar.match_index)
+            self._match_index[ar.follower] = match
+            self._next_index[ar.follower] = max(
+                self._next_index.get(ar.follower, 1), match + 1)
             self._advance_commit()
         else:
-            self._next_index[ar.follower] = max(
-                1, self._next_index.get(ar.follower, 1) - 1)
+            nxt = self._next_index.get(ar.follower, 1)
+            if ar.hint_index >= 0 and ar.hint_index < nxt - 1:
+                # Jump straight past what the follower actually has (covers
+                # an empty-log follower — hint 0 — one freshly snapshot-
+                # installed beyond our compaction point, AND one that lost
+                # its disk: no clamping against match_index here, because a
+                # wiped follower's truth supersedes our stale bookkeeping).
+                nxt = ar.hint_index + 1
+            else:
+                nxt = max(1, nxt - 1)
+            self._next_index[ar.follower] = nxt
 
     _forward_replies: dict
 

@@ -225,3 +225,59 @@ def test_log_compaction_and_snapshot_install(tmp_path, monkeypatch):
     finally:
         for n in everyone:
             n.stop()
+
+
+def test_chunked_snapshot_and_dead_peer_compaction(tmp_path, monkeypatch):
+    """Bounded-log + chunked-install regressions: compaction proceeds even
+    with a member DOWN (a dead peer cannot pin the log), and the snapshot
+    arrives as multiple ordered chunks when the map exceeds the chunk size."""
+    from corda_tpu.node.services.raft import RaftMember
+
+    monkeypatch.setattr(RaftMember, "COMPACT_THRESHOLD", 4)
+    monkeypatch.setattr(RaftMember, "SNAPSHOT_CHUNK", 3)  # force chunking
+    nodes = make_cluster(tmp_path)
+    alice = Node(NodeConfig(name="Alice", base_dir=tmp_path / "Alice",
+                            network_map=tmp_path / "netmap.json")).start()
+    everyone = nodes + [alice]
+    try:
+        leader = wait_for_leader(nodes)
+        for n in everyone:
+            n.refresh_netmap()
+
+        # Take a member down; the survivors keep committing AND compacting.
+        victim = next(n for n in nodes if n.raft_member.role != "leader")
+        name = victim.config.name
+        victim.stop()
+        nodes.remove(victim)
+        everyone.remove(victim)
+
+        for i in range(24):
+            stx = issue_and_move(alice, leader.identity, magic=300 + i)
+            h = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(everyone, lambda: h.result.done, timeout=20.0)
+            h.result.result()
+        live = [n for n in nodes]
+        pump_until(everyone, lambda: all(
+            n.raft_member.snapshot_index > 0 for n in live), timeout=20.0)
+        for n in live:
+            (log_len,) = n.db.conn.execute(
+                "SELECT COUNT(*) FROM raft_log").fetchone()
+            # Dead-peer floor: retention is bounded by ~4x threshold + tail.
+            assert log_len <= 4 * 4 + 4 + 2
+
+        # The dead member returns (old disk intact but far behind): it can
+        # only catch up through a chunked snapshot (24 entries > chunk 3).
+        reborn = Node(NodeConfig(
+            name=name, base_dir=tmp_path / name, notary="raft-simple",
+            raft_cluster=CLUSTER,
+            network_map=tmp_path / "netmap.json")).start()
+        nodes.append(reborn)
+        everyone.append(reborn)
+        for n in everyone:
+            n.refresh_netmap()
+        pump_until(everyone, lambda:
+                   reborn.uniqueness_provider.committed_count == 24,
+                   timeout=25.0)
+    finally:
+        for n in everyone:
+            n.stop()
