@@ -97,6 +97,18 @@ class _Outbox:
         with self._lock:
             return [(s, u, f) for s, p, u, f in self._mem if p == peer]
 
+    def count(self, peer: str) -> int:
+        """Pending-frame count WITHOUT materialising blobs (polled per
+        heartbeat by consensus backpressure)."""
+        if self._db is not None:
+            with self._lock:
+                (n,) = self._db.conn.execute(
+                    "SELECT COUNT(*) FROM outbox WHERE peer = ?",
+                    (peer,)).fetchone()
+            return n
+        with self._lock:
+            return sum(1 for _, p, _, _ in self._mem if p == peer)
+
     def peers(self) -> set[str]:
         if self._db is not None:
             with self._lock:
@@ -277,7 +289,7 @@ class TcpMessaging(MessagingService):
         """Undelivered (un-ACKed) frames queued for a peer — lets protocols
         that generate large resendable payloads (raft snapshots) avoid
         stuffing the durable outbox of an unreachable peer."""
-        return len(self._outbox.pending(str(to)))
+        return self._outbox.count(str(to))
 
     def _ensure_bridge(self, peer: str) -> None:
         with self._lock:
@@ -364,7 +376,11 @@ class TcpMessaging(MessagingService):
     # -- receiving ---------------------------------------------------------
 
     def _accept_loop(self) -> None:
-        self._server.settimeout(0.5)  # poll _running; also frees the port fast
+        try:
+            # Poll _running via timeout; also frees the port fast on stop.
+            self._server.settimeout(0.5)
+        except OSError:
+            return  # stop() closed the socket before this thread ran
         while self._running:
             try:
                 conn, _addr = self._server.accept()

@@ -420,6 +420,10 @@ class RaftMember:
                 sent_at = self._snapshot_sent_at.get(peer_name, 0.0)
                 backlog_fn = getattr(self.messaging, "outbox_backlog", None)
                 backlog = backlog_fn(addr) if backlog_fn is not None else 0
+                if backlog > 64:
+                    # Peer unreachable: even keepalives must stop piling into
+                    # its durable outbox (they redeliver on reconnect anyway).
+                    continue
                 if (now - sent_at >= 10 * self.HEARTBEAT * self.scale
                         and backlog <= 8):
                     # Backlog gate: a live peer ACKs frames and stays near
@@ -564,8 +568,12 @@ class RaftMember:
             if existing is None:
                 self._log_append(idx, term, cmd)
         if ae.leader_commit > self.commit_index:
-            last_idx, _ = self._log_last()
-            self.commit_index = min(ae.leader_commit, last_idx)
+            # Raft §5.3: commit only up to the VERIFIED prefix — the index of
+            # the last entry THIS append confirmed (prev + entries) — never
+            # the whole local log, which may hold stale divergent entries a
+            # prev=0 keepalive did not vouch for.
+            self.commit_index = max(self.commit_index,
+                                    min(ae.leader_commit, idx))
             self._apply_committed()
         self._send(sender, AppendReply(self.term, True, idx, self.name))
 
