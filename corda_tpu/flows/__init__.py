@@ -27,3 +27,16 @@ from .fetch import FetchAttachmentsFlow, FetchTransactionsFlow  # noqa: F401
 from .resolve import ResolveTransactionsFlow  # noqa: F401
 from .finality import BroadcastTransactionFlow, FinalityFlow  # noqa: F401
 from .data_vending import install_data_vending  # noqa: F401
+from .deal import DealAcceptorFlow, DealInstigatorFlow  # noqa: F401
+from .oracle import (  # noqa: F401
+    Fix,
+    FixOf,
+    RateOracle,
+    RatesFixQueryFlow,
+    RatesFixSignFlow,
+)
+from .state_replacement import (  # noqa: F401
+    NotaryChangeAcceptor,
+    NotaryChangeFlow,
+    install_notary_change_acceptor,
+)

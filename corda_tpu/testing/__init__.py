@@ -23,3 +23,7 @@ from .identities import (  # noqa: F401
     MINI_CORP,
     MINI_CORP_KEY,
 )
+from .mock_network import MockNetwork, MockNode  # noqa: F401
+from .ledger_dsl import ledger  # noqa: F401
+from .expect import expect, expect_events, parallel, sequence  # noqa: F401
+from .simulation import Simulation, TradeSimulation  # noqa: F401

@@ -1,3 +1,12 @@
 """Utility primitives: opaque byte wrappers, progress tracking, misc."""
 
 from .bytes import OpaqueBytes  # noqa: F401
+from .clock import Clock  # noqa: F401
+from .collections import NonEmptySet  # noqa: F401
+from .interpolators import CubicSplineInterpolator, LinearInterpolator  # noqa: F401
+from .progress import ProgressTracker, Step  # noqa: F401
+from .progress_render import ProgressRenderer  # noqa: F401
+
+# NOTE: service_identity is NOT re-exported here — it imports the crypto
+# package, which itself depends on corda_tpu.utils (cycle). Import it as
+# `from corda_tpu.utils.service_identity import generate_service_identity`.
