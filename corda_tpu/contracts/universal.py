@@ -1,0 +1,864 @@
+"""Universal (composable) contracts: one generic contract, many products.
+
+Capability match for the reference's experimental universal-contracts module
+(reference: experimental/src/main/kotlin/net/corda/contracts/universal/
+Arrangement.kt, Perceivable.kt, UniversalContract.kt:13-317, Util.kt): a
+financial product is not code but a *value* — an ``Arrangement`` tree built
+from transfers, choices ("actions") and schedules ("roll-outs"), with all
+observables ("perceivables") expressed as a symbolic expression tree. A
+single generic contract verifies every product by structural reduction:
+exercising an action, applying an oracle fixing, or rolling a schedule
+forward must transform the input arrangement into exactly the output
+arrangement.
+
+Design differences from the reference (deliberate, TPU-framework idioms):
+
+- All money amounts are integer fixed-point scaled by ``SCALE`` (10^4), the
+  same convention as ``flows.oracle.Fix.value`` — floats/BigDecimal never
+  enter the codec, so arrangement values hash canonically into tx ids.
+- Dates are integer epoch days (``finance.types``), schedule arithmetic uses
+  ``Tenor``/``BusinessCalendar`` from the finance layer.
+- Every node is a frozen dataclass registered with the canonical codec, so
+  whole products serialize, checkpoint, and Merkle-hash like any other state.
+  Determinism of arithmetic (floor-division, fixed scale) is part of the
+  contract's semantics: every node on the network reduces an arrangement to
+  bit-identical bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..crypto.composite import CompositeKey
+from ..crypto.hashes import SecureHash
+from ..crypto.party import Party, PartyAndReference
+from ..finance.types import BusinessCalendar, Tenor, days_to_date
+from ..flows.oracle import Fix, FixOf
+from ..serialization.codec import register
+from ..transactions.builder import TransactionBuilder
+from .dsl import require_that, select_command
+from .structures import (
+    CommandData,
+    Contract,
+    ContractState,
+    TransactionState,
+    TypeOnlyCommandData,
+)
+from .verification import TransactionForContract
+
+SCALE = 10_000  # fixed-point scale for amounts and rates (matches Fix.value)
+_DAY_MICROS = 86_400 * 1_000_000
+
+LT, LTE, GT, GTE = "LT", "LTE", "GT", "GTE"
+PLUS, MINUS, TIMES, DIV = "PLUS", "MINUS", "TIMES", "DIV"
+
+
+def to_quanta(units: int | float) -> int:
+    """Whole currency units -> fixed-point quanta."""
+    return round(units * SCALE)
+
+
+# ---------------------------------------------------------------------------
+# Perceivables — symbolic observables (Perceivable.kt)
+# ---------------------------------------------------------------------------
+
+
+class Perceivable:
+    """An observable value: constant, time, arithmetic, or an oracle fixing
+    (reference: Perceivable.kt:10). Structural equality; immutable."""
+
+    # Arithmetic sugar so products read like the reference's DSL.
+    def __add__(self, other):
+        return BinOp(self, PLUS, _lift(other))
+
+    def __sub__(self, other):
+        return BinOp(self, MINUS, _lift(other))
+
+    def __mul__(self, other):
+        return BinOp(self, TIMES, _lift(other))
+
+    def __floordiv__(self, other):
+        return BinOp(self, DIV, _lift(other))
+
+    def __and__(self, other):
+        return PAnd(self, _lift(other))
+
+    def __or__(self, other):
+        return POr(self, _lift(other))
+
+
+def _lift(v) -> "Perceivable":
+    return v if isinstance(v, Perceivable) else Const(v)
+
+
+@register
+@dataclass(frozen=True)
+class Const(Perceivable):
+    """A constant (Perceivable.kt Const). ints/bools/strings only — anything
+    that serializes canonically."""
+
+    value: Any
+
+
+def const(v) -> Const:
+    return Const(v)
+
+
+@register
+@dataclass(frozen=True)
+class StartDate(Perceivable):
+    """Placeholder for the current roll-out period's start day; replaced with
+    a Const during roll-out reduction (Perceivable.kt StartDate)."""
+
+
+@register
+@dataclass(frozen=True)
+class EndDate(Perceivable):
+    """Placeholder for the current roll-out period's end day."""
+
+
+@register
+@dataclass(frozen=True)
+class TimeCondition(Perceivable):
+    """Boolean observable over notarised time (Perceivable.kt
+    TimePerceivable): LTE = "before day", GTE = "after day". ``day`` is a
+    Perceivable of epoch days."""
+
+    cmp: str
+    day: Perceivable
+
+    def __post_init__(self):
+        if self.cmp not in (LTE, GTE):
+            raise ValueError(f"unsupported time comparison {self.cmp!r}")
+
+
+def before(day: int | Perceivable) -> TimeCondition:
+    return TimeCondition(LTE, _lift(day))
+
+
+def after(day: int | Perceivable) -> TimeCondition:
+    return TimeCondition(GTE, _lift(day))
+
+
+@register
+@dataclass(frozen=True)
+class PAnd(Perceivable):
+    left: Perceivable
+    right: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class POr(Perceivable):
+    left: Perceivable
+    right: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class Compare(Perceivable):
+    """left <cmp> right over fixed-point amounts (PerceivableComparison)."""
+
+    left: Perceivable
+    cmp: str
+    right: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class BinOp(Perceivable):
+    """Fixed-point arithmetic (PerceivableOperation). TIMES and DIV rescale
+    by SCALE with floor division — deterministic by construction."""
+
+    left: Perceivable
+    op: str
+    right: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class PosPart(Perceivable):
+    """max(x, 0) — the reference's UnaryPlus, the option-payoff primitive."""
+
+    arg: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class Max(Perceivable):
+    args: frozenset
+
+    def __post_init__(self):
+        object.__setattr__(self, "args", frozenset(self.args))
+
+
+@register
+@dataclass(frozen=True)
+class Min(Perceivable):
+    args: frozenset
+
+    def __post_init__(self):
+        object.__setattr__(self, "args", frozenset(self.args))
+
+
+@register
+@dataclass(frozen=True)
+class Interest(Perceivable):
+    """Simple interest accrual: amount * rate * dcf(start, end)
+    (Perceivable.kt Interest). Rate is an annualised percentage in
+    fixed-point; day-count is ACT/360 or ACT/365 on epoch days."""
+
+    amount: Perceivable
+    day_count_convention: str
+    rate: Perceivable  # percent, fixed-point (e.g. 5% = 5 * SCALE)
+    start: Perceivable  # epoch days
+    end: Perceivable
+
+
+@register
+@dataclass(frozen=True)
+class Fixing(Perceivable):
+    """An oracle fixing not yet observed (Perceivable.kt Fixing). Replaced by
+    a Const via the ApplyFixes command, which must be accompanied in the same
+    transaction by a ``Fix`` command signed by ``oracle`` — the product pins
+    the identity trusted for this source at issue time (the tear-off signing
+    pattern of flows/oracle.py, hardened over the reference which never
+    checks who signed the fix)."""
+
+    source: str
+    day: Perceivable  # epoch days
+    tenor: str
+    oracle: CompositeKey
+
+
+def fixing(source: str, day: int | Perceivable, tenor: str,
+           oracle: Party | CompositeKey) -> Fixing:
+    key = oracle.owning_key if isinstance(oracle, Party) else oracle
+    return Fixing(source, _lift(day), tenor, key)
+
+
+def interest(amount: int, dcc: str, rate, start, end) -> Interest:
+    return Interest(_lift(amount), dcc, _lift(rate), _lift(start), _lift(end))
+
+
+# ---------------------------------------------------------------------------
+# Arrangements — the product algebra (Arrangement.kt)
+# ---------------------------------------------------------------------------
+
+
+class Arrangement:
+    """A tree of rights and obligations (Arrangement.kt:9)."""
+
+
+@register
+@dataclass(frozen=True)
+class Zero(Arrangement):
+    """No rights, no obligations; termination is a transition to Zero."""
+
+
+ZERO = Zero()
+
+
+@register
+@dataclass(frozen=True)
+class Transfer(Arrangement):
+    """Immediate transfer of ``amount`` quanta of ``currency`` from
+    ``from_party`` to ``to_party`` (Arrangement.kt Obligation — renamed: this
+    framework already has an Obligation *contract* in the finance layer)."""
+
+    amount: Perceivable
+    currency: str
+    from_party: Party
+    to_party: Party
+
+
+@register
+@dataclass(frozen=True)
+class All(Arrangement):
+    """Conjunction of independent arrangements (Arrangement.kt And)."""
+
+    arrangements: frozenset
+
+    def __post_init__(self):
+        object.__setattr__(self, "arrangements", frozenset(self.arrangements))
+
+
+@register
+@dataclass(frozen=True)
+class Action(Arrangement):
+    """A named transition any of ``actors`` may take when ``condition`` holds
+    (Arrangement.kt Action)."""
+
+    name: str
+    condition: Perceivable
+    actors: frozenset  # of Party
+    arrangement: Arrangement
+
+    def __post_init__(self):
+        object.__setattr__(self, "actors", frozenset(self.actors))
+
+
+@register
+@dataclass(frozen=True)
+class Actions(Arrangement):
+    """The menu of available transitions (Arrangement.kt Actions)."""
+
+    actions: frozenset  # of Action
+
+    def __post_init__(self):
+        object.__setattr__(self, "actions", frozenset(self.actions))
+
+
+@register
+@dataclass(frozen=True)
+class RollOut(Arrangement):
+    """A schedule: instantiate ``template`` per period from start to end at
+    ``frequency`` (Arrangement.kt RollOut). The template refers to the
+    current period via StartDate/EndDate and recurses via Continuation."""
+
+    start_day: int
+    end_day: int
+    frequency: Tenor
+    template: Arrangement
+
+
+@register
+@dataclass(frozen=True)
+class Continuation(Arrangement):
+    """Inside a RollOut template: "the rest of the schedule"."""
+
+
+def actions(*acts: Action) -> Actions:
+    return Actions(frozenset(acts))
+
+
+def arrange(name: str, condition: Perceivable, actors, arrangement: Arrangement) -> Action:
+    party_set = {actors} if isinstance(actors, Party) else set(actors)
+    return Action(name, condition, frozenset(party_set), arrangement)
+
+
+def transfer(amount, currency: str, from_party: Party, to_party: Party) -> Transfer:
+    return Transfer(_lift(amount), currency, from_party, to_party)
+
+
+def all_of(*arrangements: Arrangement) -> Arrangement:
+    flat = [a for a in arrangements if a != ZERO]
+    if not flat:
+        return ZERO
+    if len(flat) == 1:
+        return flat[0]
+    return All(frozenset(flat))
+
+
+# ---------------------------------------------------------------------------
+# Structural utilities (Util.kt)
+# ---------------------------------------------------------------------------
+
+
+def liable_parties(arrangement: Arrangement) -> frozenset[CompositeKey]:
+    """Keys of parties that may end up owing something (Util.kt
+    liableParties:15-36): transfer sources, minus an action's sole actor (a
+    party can't be surprised by an obligation only they can trigger)."""
+    if isinstance(arrangement, (Zero, Continuation)):
+        return frozenset()
+    if isinstance(arrangement, Transfer):
+        return frozenset({arrangement.from_party.owning_key})
+    if isinstance(arrangement, All):
+        out: frozenset = frozenset()
+        for a in arrangement.arrangements:
+            out |= liable_parties(a)
+        return out
+    if isinstance(arrangement, Actions):
+        out = frozenset()
+        for act in arrangement.actions:
+            inner = liable_parties(act.arrangement)
+            if len(act.actors) == 1:
+                inner -= {next(iter(act.actors)).owning_key}
+            out |= inner
+        return out
+    if isinstance(arrangement, RollOut):
+        return liable_parties(arrangement.template)
+    raise TypeError(f"liable_parties: {type(arrangement).__name__}")
+
+
+def involved_parties(arrangement: Arrangement) -> frozenset[CompositeKey]:
+    """Every key mentioned by the product (Util.kt involvedParties:38-53)."""
+    if isinstance(arrangement, (Zero, Continuation)):
+        return frozenset()
+    if isinstance(arrangement, Transfer):
+        return frozenset(
+            {arrangement.from_party.owning_key, arrangement.to_party.owning_key})
+    if isinstance(arrangement, All):
+        out: frozenset = frozenset()
+        for a in arrangement.arrangements:
+            out |= involved_parties(a)
+        return out
+    if isinstance(arrangement, Actions):
+        out = frozenset()
+        for act in arrangement.actions:
+            out |= involved_parties(act.arrangement)
+            out |= frozenset(p.owning_key for p in act.actors)
+        return out
+    if isinstance(arrangement, RollOut):
+        return involved_parties(arrangement.template)
+    raise TypeError(f"involved_parties: {type(arrangement).__name__}")
+
+
+def replace_party(arrangement: Arrangement, old: Party, new: Party) -> Arrangement:
+    """Substitute a party everywhere (Util.kt replaceParty:55-71)."""
+    if isinstance(arrangement, (Zero, Continuation)):
+        return arrangement
+    if isinstance(arrangement, Transfer):
+        return Transfer(
+            arrangement.amount, arrangement.currency,
+            new if arrangement.from_party == old else arrangement.from_party,
+            new if arrangement.to_party == old else arrangement.to_party)
+    if isinstance(arrangement, All):
+        return All(frozenset(
+            replace_party(a, old, new) for a in arrangement.arrangements))
+    if isinstance(arrangement, Actions):
+        return Actions(frozenset(
+            Action(a.name, a.condition,
+                   frozenset(new if p == old else p for p in a.actors),
+                   replace_party(a.arrangement, old, new))
+            for a in arrangement.actions))
+    if isinstance(arrangement, RollOut):
+        return RollOut(arrangement.start_day, arrangement.end_day,
+                       arrangement.frequency,
+                       replace_party(arrangement.template, old, new))
+    raise TypeError(f"replace_party: {type(arrangement).__name__}")
+
+
+def actions_of(arrangement: Arrangement) -> dict[str, Action]:
+    """Name -> Action over the top level (Util.kt actions:86-99)."""
+    if isinstance(arrangement, (Zero, Transfer, RollOut)):
+        return {}
+    if isinstance(arrangement, Actions):
+        return {a.name: a for a in arrangement.actions}
+    if isinstance(arrangement, All):
+        out: dict[str, Action] = {}
+        for a in arrangement.arrangements:
+            out.update(actions_of(a))
+        return out
+    raise TypeError(f"actions_of: {type(arrangement).__name__}")
+
+
+def extract_remainder(arrangement: Arrangement, action: Action) -> Arrangement:
+    """What's left if ``action`` is exercised (Util.kt extractRemainder)."""
+    if isinstance(arrangement, Actions):
+        return ZERO if action in arrangement.actions else arrangement
+    if isinstance(arrangement, All):
+        rest = [extract_remainder(a, action) for a in arrangement.arrangements]
+        return all_of(*rest)
+    return arrangement
+
+
+# --- roll-out reduction (UniversalContract.kt reduceRollOut:103-121) -------
+
+
+def _substitute(p: Perceivable, mapping) -> Perceivable:
+    """Rebuild a perceivable tree with ``mapping`` applied to each node
+    bottom-up. mapping(node) returns a replacement or None."""
+    if isinstance(p, (Const, StartDate, EndDate)):
+        pass  # leaves
+    elif isinstance(p, TimeCondition):
+        p = TimeCondition(p.cmp, _substitute(p.day, mapping))
+    elif isinstance(p, (PAnd, POr)):
+        p = type(p)(_substitute(p.left, mapping), _substitute(p.right, mapping))
+    elif isinstance(p, Compare):
+        p = Compare(_substitute(p.left, mapping), p.cmp,
+                    _substitute(p.right, mapping))
+    elif isinstance(p, BinOp):
+        p = BinOp(_substitute(p.left, mapping), p.op,
+                  _substitute(p.right, mapping))
+    elif isinstance(p, PosPart):
+        p = PosPart(_substitute(p.arg, mapping))
+    elif isinstance(p, (Max, Min)):
+        p = type(p)(frozenset(_substitute(a, mapping) for a in p.args))
+    elif isinstance(p, Interest):
+        p = Interest(_substitute(p.amount, mapping), p.day_count_convention,
+                     _substitute(p.rate, mapping), _substitute(p.start, mapping),
+                     _substitute(p.end, mapping))
+    elif isinstance(p, Fixing):
+        p = Fixing(p.source, _substitute(p.day, mapping), p.tenor, p.oracle)
+    else:
+        raise TypeError(f"substitute: {type(p).__name__}")
+    replacement = mapping(p)
+    return p if replacement is None else replacement
+
+
+def _map_arrangement(arrangement: Arrangement, p_map, a_map) -> Arrangement:
+    """Rebuild an arrangement tree applying p_map to every perceivable and
+    a_map to every arrangement node (bottom-up)."""
+    if isinstance(arrangement, (Zero, Continuation)):
+        out: Arrangement = arrangement
+    elif isinstance(arrangement, Transfer):
+        out = Transfer(_substitute(arrangement.amount, p_map),
+                       arrangement.currency, arrangement.from_party,
+                       arrangement.to_party)
+    elif isinstance(arrangement, All):
+        out = All(frozenset(_map_arrangement(a, p_map, a_map)
+                            for a in arrangement.arrangements))
+    elif isinstance(arrangement, Actions):
+        out = Actions(frozenset(
+            Action(a.name, _substitute(a.condition, p_map), a.actors,
+                   _map_arrangement(a.arrangement, p_map, a_map))
+            for a in arrangement.actions))
+    elif isinstance(arrangement, RollOut):
+        out = RollOut(arrangement.start_day, arrangement.end_day,
+                      arrangement.frequency,
+                      _map_arrangement(arrangement.template, p_map, a_map))
+    else:
+        raise TypeError(f"map_arrangement: {type(arrangement).__name__}")
+    replacement = a_map(out)
+    return out if replacement is None else replacement
+
+
+def reduce_rollout(roll: RollOut,
+                   calendar: BusinessCalendar = BusinessCalendar()) -> Arrangement:
+    """Expand one period of a schedule (UniversalContract.kt
+    reduceRollOut:103-121): instantiate the template with this period's
+    start/end, and splice either the remaining RollOut (via Continuation) or
+    nothing if this was the last period."""
+    period_end = calendar.advance(roll.start_day, roll.frequency)
+    this_start, this_end = roll.start_day, min(period_end, roll.end_day)
+
+    def p_map(p):
+        if isinstance(p, StartDate):
+            return Const(this_start)
+        if isinstance(p, EndDate):
+            return Const(this_end)
+        return None
+
+    if period_end < roll.end_day:
+        rest: Arrangement = RollOut(period_end, roll.end_day, roll.frequency,
+                                    roll.template)
+    else:
+        rest = ZERO
+
+    def a_map(a):
+        if isinstance(a, Continuation):
+            return rest
+        if isinstance(a, All):  # renormalise after Continuation -> Zero
+            return all_of(*a.arrangements)
+        return None
+
+    return _map_arrangement(roll.template, p_map, a_map)
+
+
+def replace_fixings(arrangement: Arrangement, fixes: dict[FixOf, int],
+                    used: set | None = None,
+                    oracles: dict | None = None) -> Arrangement:
+    """Substitute observed oracle values for Fixing nodes
+    (UniversalContract.kt replaceFixing:246-290). ``used`` collects the
+    FixOfs actually consumed so verify can insist none were superfluous;
+    ``oracles`` collects FixOf -> pinned oracle CompositeKey so verify can
+    insist each substitution was signed by the key the product trusts."""
+    consumed = set() if used is None else used
+    trusted = {} if oracles is None else oracles
+
+    def p_map(p):
+        if isinstance(p, Fixing) and isinstance(p.day, Const):
+            key = FixOf(p.source, p.day.value, p.tenor)
+            if key in fixes:
+                consumed.add(key)
+                trusted[key] = p.oracle
+                return Const(fixes[key])
+        return None
+
+    return _map_arrangement(arrangement, p_map, lambda a: None)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation (UniversalContract.kt eval:34-90)
+# ---------------------------------------------------------------------------
+
+
+class EvalError(Exception):
+    """A perceivable could not be reduced to a value (unfixed oracle data,
+    unresolved StartDate/EndDate, malformed tree)."""
+
+
+def eval_amount(tx: TransactionForContract, p: Perceivable) -> int:
+    """Reduce to fixed-point quanta. Arithmetic is exact for +/-, floor-
+    rescaled for * and / — every node computes identical ints."""
+    if isinstance(p, Const):
+        if not isinstance(p.value, int) or isinstance(p.value, bool):
+            raise EvalError(f"non-numeric constant {p.value!r}")
+        return p.value
+    if isinstance(p, BinOp):
+        left, right = eval_amount(tx, p.left), eval_amount(tx, p.right)
+        if p.op == PLUS:
+            return left + right
+        if p.op == MINUS:
+            return left - right
+        if p.op == TIMES:
+            return (left * right) // SCALE
+        if p.op == DIV:
+            if right == 0:
+                raise EvalError("division by zero")
+            return (left * SCALE) // right
+        raise EvalError(f"unknown op {p.op!r}")
+    if isinstance(p, PosPart):
+        return max(eval_amount(tx, p.arg), 0)
+    if isinstance(p, Max):
+        return max(eval_amount(tx, a) for a in p.args)
+    if isinstance(p, Min):
+        return min(eval_amount(tx, a) for a in p.args)
+    if isinstance(p, Interest):
+        principal = eval_amount(tx, p.amount)
+        rate = eval_amount(tx, p.rate)  # percent, fixed-point
+        start, end = eval_day(tx, p.start), eval_day(tx, p.end)
+        basis = {"ACT/360": 360, "ACT/365": 365}.get(p.day_count_convention)
+        if basis is None:
+            raise EvalError(f"unknown day count {p.day_count_convention!r}")
+        # principal * (rate/100) * days/basis, all in fixed point.
+        return (principal * rate * (end - start)) // (100 * SCALE * basis)
+    if isinstance(p, Fixing):
+        raise EvalError(
+            f"unfixed oracle value {p.source} — an ApplyFixes command must "
+            "substitute it before it can be evaluated")
+    raise EvalError(f"eval_amount: {type(p).__name__}")
+
+
+def eval_day(tx: TransactionForContract, p: Perceivable) -> int:
+    if isinstance(p, Const):
+        if not isinstance(p.value, int):
+            raise EvalError(f"non-day constant {p.value!r}")
+        return p.value
+    if isinstance(p, (StartDate, EndDate)):
+        raise EvalError("start/end date outside a roll-out context")
+    raise EvalError(f"eval_day: {type(p).__name__}")
+
+
+def eval_condition(tx: TransactionForContract, p: Perceivable) -> bool:
+    if isinstance(p, Const):
+        if not isinstance(p.value, bool):
+            raise EvalError(f"non-boolean constant {p.value!r}")
+        return p.value
+    if isinstance(p, PAnd):
+        return eval_condition(tx, p.left) and eval_condition(tx, p.right)
+    if isinstance(p, POr):
+        return eval_condition(tx, p.left) or eval_condition(tx, p.right)
+    if isinstance(p, TimeCondition):
+        if tx.timestamp is None:
+            raise EvalError("time condition on an untimestamped transaction")
+        day_micros = eval_day(tx, p.day) * _DAY_MICROS
+        if p.cmp == LTE:  # "before day": latest possible time <= day
+            return tx.timestamp.before is not None and tx.timestamp.before <= day_micros
+        # GTE, "after day": earliest possible time >= day
+        return tx.timestamp.after is not None and tx.timestamp.after >= day_micros
+    if isinstance(p, Compare):
+        left, right = eval_amount(tx, p.left), eval_amount(tx, p.right)
+        return {LT: left < right, LTE: left <= right,
+                GT: left > right, GTE: left >= right}[p.cmp]
+    raise EvalError(f"eval_condition: {type(p).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# The contract (UniversalContract.kt:13-317)
+# ---------------------------------------------------------------------------
+
+
+class UniversalCommand(CommandData):
+    """Marker base for the universal contract's commands."""
+
+
+@register
+@dataclass(frozen=True)
+class UIssue(TypeOnlyCommandData, UniversalCommand):
+    """Put a product on ledger; all liable parties must sign."""
+
+
+@register
+@dataclass(frozen=True)
+class UMove(UniversalCommand):
+    """Replace a party; liable parties of the result must sign."""
+
+    old: Party
+    new: Party
+
+
+@register
+@dataclass(frozen=True)
+class UAction(UniversalCommand):
+    """Exercise the named action."""
+
+    name: str
+
+
+@register
+@dataclass(frozen=True)
+class UApplyFixes(UniversalCommand):
+    """Substitute oracle fixings into the product. The same transaction
+    carries the corresponding oracle-signed ``Fix`` commands (the tear-off
+    pattern of flows/oracle.py), so the substitution is attested."""
+
+    fixes: tuple  # of Fix
+
+    def __post_init__(self):
+        object.__setattr__(self, "fixes", tuple(self.fixes))
+
+
+class UniversalContract(Contract):
+    """The one contract that verifies every arrangement
+    (UniversalContract.kt verify:182-245)."""
+
+    @property
+    def legal_contract_reference(self) -> SecureHash:
+        return SecureHash.sha256(b"corda_tpu/universal-contract")
+
+    def verify(self, tx: TransactionForContract) -> None:
+        cmd = select_command(tx.commands, UniversalCommand)
+        value = cmd.value
+
+        if isinstance(value, UIssue):
+            with require_that() as req:
+                req("the transaction has no input states", not tx.inputs)
+                out = self._single_state(tx.outputs, "output")
+                req("the transaction is signed by all liable parties",
+                    liable_parties(out.details) <= frozenset(cmd.signers))
+
+        elif isinstance(value, UMove):
+            in_state = self._single_state(tx.inputs, "input")
+            out = self._single_state(tx.outputs, "output")
+            with require_that() as req:
+                req("the transaction is signed by all liable parties",
+                    liable_parties(out.details) <= frozenset(cmd.signers))
+                req("output state reflects the move command",
+                    replace_party(in_state.details, value.old, value.new)
+                    == out.details)
+
+        elif isinstance(value, UAction):
+            in_state = self._single_state(tx.inputs, "input")
+            arr = self._reducible(in_state.details)
+            action = actions_of(arr).get(value.name)
+            with require_that() as req:
+                req("action must be defined", action is not None)
+                req("action must be timestamped", tx.timestamp is not None)
+                actor_keys = {p.owning_key for p in action.actors}
+                req("action must be authorized",
+                    any(s in actor_keys for s in cmd.signers))
+                req("condition must be met",
+                    eval_condition(tx, action.condition))
+                # Single-state model as in the reference (verify:206-210):
+                # exercising an action consumes the whole input arrangement.
+                req("exercising an action must consume the whole state",
+                    extract_remainder(arr, action) == ZERO)
+            result = self._validate_transfers(tx, action.arrangement)
+            if not tx.outputs:
+                with require_that() as req:
+                    req("action result must be Zero for an output-less "
+                        "transaction", result == ZERO)
+            elif len(tx.outputs) == 1:
+                with require_that() as req:
+                    req("output state must match action result state",
+                        result == tx.outputs[0].details)
+            else:
+                combined = all_of(*(o.details for o in tx.outputs))
+                with require_that() as req:
+                    req("output states must match action result state",
+                        result == combined)
+
+        elif isinstance(value, UApplyFixes):
+            in_state = self._single_state(tx.inputs, "input")
+            out = self._single_state(tx.outputs, "output")
+            arr = self._reducible(in_state.details)
+            fixes = {f.of: f.value for f in value.fixes}
+            # FixOf -> set of leaf keys that signed a Fix command with that
+            # exact (of, value). Only signatures over the matching value
+            # count as attestation.
+            attested: dict[FixOf, set] = {}
+            for c in tx.commands:
+                if isinstance(c.value, Fix) \
+                        and fixes.get(c.value.of) == c.value.value:
+                    leaves = attested.setdefault(c.value.of, set())
+                    for signer in c.signers:
+                        leaves |= set(signer.keys)
+            used: set = set()
+            oracles: dict = {}
+            expected = replace_fixings(arr, fixes, used, oracles)
+            with require_that() as req:
+                req("relevant fixing must be included", used == set(fixes))
+                req("every fix is attested by a Fix command signed by the "
+                    "oracle the product pins for its source", all(
+                        oracles[of].is_fulfilled_by(attested.get(of, set()))
+                        for of in used))
+                req("output state reflects the fix command",
+                    expected == out.details)
+        else:
+            raise ValueError(f"Unrecognised command {type(value).__name__}")
+
+    @staticmethod
+    def _single_state(states, what: str) -> "UniversalState":
+        if len(states) != 1:
+            raise ValueError(f"expected exactly one {what} state")
+        state = states[0]
+        if not isinstance(state, UniversalState):
+            raise ValueError(f"{what} state is not a UniversalState")
+        return state
+
+    @staticmethod
+    def _reducible(details: Arrangement) -> Arrangement:
+        """An input arrangement ready for action lookup: Actions directly, or
+        a RollOut expanded by one period (verify:188-193)."""
+        if isinstance(details, Actions):
+            return details
+        if isinstance(details, RollOut):
+            return reduce_rollout(details)
+        raise ValueError(
+            f"unexpected arrangement {type(details).__name__}: only Actions "
+            "or RollOut states can transition")
+
+    def _validate_transfers(self, tx: TransactionForContract,
+                            arrangement: Arrangement) -> Arrangement:
+        """Evaluate every immediate transfer amount to a non-negative
+        constant (UniversalContract.kt validateImmediateTransfers:92-100)."""
+        if isinstance(arrangement, Transfer):
+            amount = eval_amount(tx, arrangement.amount)
+            with require_that() as req:
+                req("transferred quantity is non-negative", amount >= 0)
+            return Transfer(Const(amount), arrangement.currency,
+                            arrangement.from_party, arrangement.to_party)
+        if isinstance(arrangement, All):
+            return all_of(*(self._validate_transfers(tx, a)
+                            for a in arrangement.arrangements))
+        return arrangement
+
+
+UNIVERSAL_PROGRAM = UniversalContract()
+
+
+@register
+@dataclass(frozen=True)
+class UniversalState(ContractState):
+    """The on-ledger holder of an arrangement (UniversalContract.kt State)."""
+
+    parts: tuple  # of CompositeKey (participants)
+    details: Arrangement
+
+    def __post_init__(self):
+        object.__setattr__(self, "parts", tuple(self.parts))
+
+    @property
+    def contract(self) -> Contract:
+        return UNIVERSAL_PROGRAM
+
+    @property
+    def participants(self) -> list[CompositeKey]:
+        return list(self.parts)
+
+
+# --- transaction generation (UniversalContract.kt generateIssue:311-316) ----
+
+
+def generate_issue(arrangement: Arrangement, at: PartyAndReference,
+                   notary: Party) -> TransactionBuilder:
+    builder = TransactionBuilder(notary=notary)
+    keys = sorted(involved_parties(arrangement),
+                  key=lambda k: k.to_base58_string())
+    builder.add_output_state(
+        TransactionState(UniversalState(tuple(keys), arrangement), notary))
+    builder.add_command(UIssue(), at.party.owning_key)
+    return builder

@@ -1,0 +1,399 @@
+"""Universal (composable) contract tests.
+
+Mirrors the reference's experimental universal-contract suite (reference:
+experimental/src/test/kotlin/net/corda/contracts/universal/
+{ZeroCouponBond,FXSwap,Cap,RollOutTests}.kt) at the rules tier: products are
+arrangement values, and the one UniversalContract verifies issue, exercise,
+party replacement, oracle fixing, and schedule roll-out structurally.
+"""
+
+import pytest
+
+from corda_tpu.contracts.structures import Timestamp
+from corda_tpu.contracts.universal import (
+    SCALE,
+    ZERO,
+    Actions,
+    All,
+    Compare,
+    Const,
+    Continuation,
+    EndDate,
+    Fixing,
+    GT,
+    Interest,
+    PosPart,
+    RollOut,
+    StartDate,
+    TimeCondition,
+    Transfer,
+    UAction,
+    UApplyFixes,
+    UIssue,
+    UMove,
+    UniversalState,
+    actions,
+    after,
+    all_of,
+    arrange,
+    before,
+    eval_amount,
+    eval_condition,
+    fixing,
+    interest,
+    involved_parties,
+    liable_parties,
+    reduce_rollout,
+    replace_fixings,
+    replace_party,
+    to_quanta,
+    transfer,
+)
+from corda_tpu.finance.types import Tenor, date_to_days
+from corda_tpu.flows.oracle import Fix, FixOf
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.party import Party
+from corda_tpu.serialization.codec import serialize, deserialize
+from corda_tpu.testing.ledger_dsl import ledger
+
+import datetime as dt
+
+ACME = Party.of("ACME", KeyPair.generate(b"\x61" * 32).public)
+HIGH_ST = Party.of("HighStreetBank", KeyPair.generate(b"\x62" * 32).public)
+MOMENTUM = Party.of("Momentum", KeyPair.generate(b"\x63" * 32).public)
+NOTARY = Party.of("Notary", KeyPair.generate(b"\x64" * 32).public)
+
+MATURITY = date_to_days(dt.date(2017, 9, 1))
+_DAY_MICROS = 86_400 * 1_000_000
+
+
+def day_ts(day, slack_days=0):
+    """A timestamp window proving the tx happened on/after `day`."""
+    return Timestamp(day * _DAY_MICROS, (day + slack_days + 1) * _DAY_MICROS)
+
+
+def ustate(arrangement):
+    keys = sorted(involved_parties(arrangement),
+                  key=lambda k: k.to_base58_string())
+    return UniversalState(tuple(keys), arrangement)
+
+
+def zcb(amount=to_quanta(100_000)):
+    """Zero-coupon bond: after maturity ACME may demand payment from the bank
+    (reference: ZeroCouponBond.kt)."""
+    return actions(
+        arrange("execute", after(MATURITY), ACME,
+                transfer(amount, "USD", HIGH_ST, ACME)))
+
+
+class TestStructure:
+    def test_liable_and_involved_parties(self):
+        contract = zcb()
+        assert liable_parties(contract) == frozenset({HIGH_ST.owning_key})
+        assert involved_parties(contract) == frozenset(
+            {HIGH_ST.owning_key, ACME.owning_key})
+
+    def test_sole_actor_not_liable(self):
+        # A party whose obligation only they can trigger is not "liable".
+        give_away = actions(
+            arrange("donate", after(MATURITY), ACME,
+                    transfer(to_quanta(1), "USD", ACME, HIGH_ST)))
+        assert liable_parties(give_away) == frozenset()
+
+    def test_replace_party(self):
+        moved = replace_party(zcb(), ACME, MOMENTUM)
+        assert liable_parties(moved) == frozenset({HIGH_ST.owning_key})
+        assert involved_parties(moved) == frozenset(
+            {HIGH_ST.owning_key, MOMENTUM.owning_key})
+
+    def test_arrangements_serialize_canonically(self):
+        contract = zcb()
+        blob = serialize(contract)
+        assert deserialize(blob) == contract
+        # structural equality is order-insensitive (frozensets)
+        both = all_of(zcb(), transfer(1, "EUR", ACME, HIGH_ST))
+        assert deserialize(serialize(both)) == both
+
+
+class TestEval:
+    def test_fixed_point_arithmetic(self):
+        p = (Const(to_quanta(3)) * Const(to_quanta(2))
+             - Const(to_quanta(1))) // Const(to_quanta(5))
+        assert eval_amount(None, p) == to_quanta(1)
+
+    def test_pospart_is_option_payoff(self):
+        assert eval_amount(None, PosPart(Const(-5))) == 0
+        assert eval_amount(None, PosPart(Const(7))) == 7
+
+    def test_interest_act360(self):
+        p = interest(to_quanta(1_000_000), "ACT/360", Const(5 * SCALE),
+                     Const(0), Const(360))
+        assert eval_amount(None, p) == to_quanta(50_000)
+
+    def test_time_conditions(self):
+        class Tx:
+            timestamp = day_ts(MATURITY)
+
+        assert eval_condition(Tx, after(MATURITY))
+        assert not eval_condition(Tx, before(MATURITY - 1))
+        assert eval_condition(Tx, before(MATURITY + 2))
+
+    def test_compare(self):
+        class Tx:
+            timestamp = None
+
+        assert eval_condition(Tx, Compare(Const(3), GT, Const(2)))
+
+
+class TestZeroCouponBond:
+    """reference: ZeroCouponBond.kt — issue, transfer (move), execute."""
+
+    def test_issue_requires_liable_signature(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output("zcb", ustate(zcb()))
+            tx.command(UIssue(), ACME.owning_key)
+            tx.fails_with("liable parties")
+        with l.transaction() as tx:
+            tx.output("zcb", ustate(zcb()))
+            tx.command(UIssue(), HIGH_ST.owning_key)
+            tx.verifies()
+
+    def test_execute_after_maturity(self):
+        settlement = transfer(Const(to_quanta(100_000)), "USD", HIGH_ST, ACME)
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output("zcb", ustate(zcb()))
+            tx.command(UIssue(), HIGH_ST.owning_key)
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("zcb")
+            tx.output("settled", ustate(settlement))
+            tx.command(UAction("execute"), ACME.owning_key)
+            with tx.tweak() as tw:
+                tw.fails_with("timestamped")
+            tx.timestamp(day_ts(MATURITY - 10))
+            with tx.tweak() as tw:
+                tw.fails_with("condition must be met")
+            tx.timestamp(day_ts(MATURITY))
+            tx.verifies()
+
+    def test_execute_needs_an_actor_signature(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(zcb()))
+            tx.output(None, ustate(
+                transfer(Const(to_quanta(100_000)), "USD", HIGH_ST, ACME)))
+            tx.command(UAction("execute"), HIGH_ST.owning_key)
+            tx.timestamp(day_ts(MATURITY))
+            tx.fails_with("authorized")
+
+    def test_wrong_output_rejected(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(zcb()))
+            tx.output(None, ustate(
+                transfer(Const(to_quanta(50_000)), "USD", HIGH_ST, ACME)))
+            tx.command(UAction("execute"), ACME.owning_key)
+            tx.timestamp(day_ts(MATURITY))
+            tx.fails_with("match action result")
+
+    def test_move_to_new_party(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(zcb()))
+            tx.output(None, ustate(replace_party(zcb(), ACME, MOMENTUM)))
+            tx.command(UMove(ACME, MOMENTUM), HIGH_ST.owning_key)
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input(ustate(zcb()))
+            tx.output(None, ustate(replace_party(zcb(), ACME, MOMENTUM)))
+            tx.command(UMove(ACME, MOMENTUM), MOMENTUM.owning_key)
+            tx.fails_with("liable parties")
+
+
+class TestFXSwap:
+    """reference: FXSwap.kt — one action settles two legs (multi-output)."""
+
+    def setup_method(self):
+        self.swap = actions(
+            arrange("execute", after(MATURITY), {ACME, HIGH_ST},
+                    all_of(
+                        transfer(to_quanta(1_200_000), "USD", ACME, HIGH_ST),
+                        transfer(to_quanta(1_000_000), "EUR", HIGH_ST, ACME))))
+
+    def test_both_parties_liable(self):
+        assert liable_parties(self.swap) == frozenset(
+            {ACME.owning_key, HIGH_ST.owning_key})
+
+    def test_execute_splits_into_two_outputs(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.swap))
+            tx.output(None, ustate(transfer(
+                Const(to_quanta(1_200_000)), "USD", ACME, HIGH_ST)))
+            tx.output(None, ustate(transfer(
+                Const(to_quanta(1_000_000)), "EUR", HIGH_ST, ACME)))
+            tx.command(UAction("execute"), ACME.owning_key)
+            tx.timestamp(day_ts(MATURITY))
+            tx.verifies()
+
+    def test_half_settlement_rejected(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.swap))
+            tx.output(None, ustate(transfer(
+                Const(to_quanta(1_200_000)), "USD", ACME, HIGH_ST)))
+            tx.command(UAction("execute"), ACME.owning_key)
+            tx.timestamp(day_ts(MATURITY))
+            tx.fails_with("match action result")
+
+
+class TestFixings:
+    """reference: Caplet.kt/Cap.kt fixing flow — UApplyFixes substitutes an
+    oracle-attested rate into the product."""
+
+    def setup_method(self):
+        fix_day = date_to_days(dt.date(2017, 3, 1))
+        self.fix_of = FixOf("LIBOR", fix_day, "3M")
+        rate = fixing("LIBOR", fix_day, "3M", MOMENTUM)  # MOMENTUM = oracle
+        notional = to_quanta(10_000_000)
+        self.capped = actions(
+            arrange("exercise", after(MATURITY), ACME,
+                    transfer(
+                        PosPart(Interest(Const(notional), "ACT/360",
+                                         rate - Const(4 * SCALE),
+                                         Const(fix_day), Const(MATURITY))),
+                        "USD", HIGH_ST, ACME)))
+        self.fixed_value = 5 * SCALE  # 5%
+
+    def fixed_product(self):
+        return replace_fixings(self.capped, {self.fix_of: self.fixed_value})
+
+    def test_apply_fixes(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.capped))
+            tx.output(None, ustate(self.fixed_product()))
+            tx.command(UApplyFixes((Fix(self.fix_of, self.fixed_value),)),
+                       ACME.owning_key)
+            tx.command(Fix(self.fix_of, self.fixed_value), MOMENTUM.owning_key)
+            tx.verifies()
+
+    def test_unattested_fix_rejected(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.capped))
+            tx.output(None, ustate(self.fixed_product()))
+            tx.command(UApplyFixes((Fix(self.fix_of, self.fixed_value),)),
+                       ACME.owning_key)
+            tx.fails_with("attested")
+
+    def test_fix_signed_by_wrong_party_rejected(self):
+        # ACME fabricates the fix and self-signs the Fix command: the product
+        # pins MOMENTUM as the LIBOR oracle, so this must not verify.
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.capped))
+            tx.output(None, ustate(self.fixed_product()))
+            tx.command(UApplyFixes((Fix(self.fix_of, self.fixed_value),)),
+                       ACME.owning_key)
+            tx.command(Fix(self.fix_of, self.fixed_value), ACME.owning_key)
+            tx.fails_with("attested")
+
+    def test_fix_attesting_different_value_rejected(self):
+        # Oracle signed 5%, the command claims 9%: signature over a different
+        # value is not attestation.
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.capped))
+            tx.output(None, ustate(replace_fixings(
+                self.capped, {self.fix_of: 9 * SCALE})))
+            tx.command(UApplyFixes((Fix(self.fix_of, 9 * SCALE),)),
+                       ACME.owning_key)
+            tx.command(Fix(self.fix_of, self.fixed_value),
+                       MOMENTUM.owning_key)
+            tx.fails_with("attested")
+
+    def test_superfluous_fix_rejected(self):
+        bogus = FixOf("LIBOR", 1, "6M")
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.capped))
+            tx.output(None, ustate(self.fixed_product()))
+            tx.command(UApplyFixes((Fix(self.fix_of, self.fixed_value),
+                                    Fix(bogus, 1))), ACME.owning_key)
+            tx.command(Fix(self.fix_of, self.fixed_value), MOMENTUM.owning_key)
+            tx.command(Fix(bogus, 1), MOMENTUM.owning_key)
+            tx.fails_with("relevant fixing")
+
+    def test_fixed_product_evaluates(self):
+        fixed = self.fixed_product()
+        action = next(iter(fixed.actions))
+        amount = eval_amount(None, action.arrangement.amount)
+        days = MATURITY - self.fix_of.for_day
+        expected = (to_quanta(10_000_000) * (1 * SCALE) * days) \
+            // (100 * SCALE * 360)
+        assert amount == expected > 0
+
+
+class TestRollOut:
+    """reference: RollOutTests.kt — schedules expand one period at a time."""
+
+    def setup_method(self):
+        start = date_to_days(dt.date(2017, 1, 2))  # a Monday
+        end = date_to_days(dt.date(2017, 4, 3))
+        template = actions(
+            arrange("pay", after(EndDate()), ACME,
+                    all_of(
+                        transfer(Interest(Const(to_quanta(1_000_000)),
+                                          "ACT/360", Const(5 * SCALE),
+                                          StartDate(), EndDate()),
+                                 "USD", HIGH_ST, ACME),
+                        Continuation())))
+        self.roll = RollOut(start, end, Tenor("1M"), template)
+
+    def test_reduce_substitutes_period_and_continuation(self):
+        reduced = reduce_rollout(self.roll)
+        assert isinstance(reduced, Actions)
+        action = next(iter(reduced.actions))
+        assert isinstance(action.arrangement, All)
+        parts = set(action.arrangement.arrangements)
+        rolls = [p for p in parts if isinstance(p, RollOut)]
+        pays = [p for p in parts if isinstance(p, Transfer)]
+        assert len(rolls) == 1 and len(pays) == 1
+        assert rolls[0].start_day > self.roll.start_day
+        assert rolls[0].end_day == self.roll.end_day
+        # period dates were substituted into the transfer amount
+        assert isinstance(pays[0].amount, Interest)
+        assert pays[0].amount.start == Const(self.roll.start_day)
+
+    def test_final_period_drops_continuation(self):
+        short = RollOut(self.roll.start_day,
+                        self.roll.start_day + 20, Tenor("1M"),
+                        self.roll.template)
+        reduced = reduce_rollout(short)
+        action = next(iter(reduced.actions))
+        assert isinstance(action.arrangement, Transfer)  # no Continuation left
+
+    def test_exercise_rolled_period_on_ledger(self):
+        reduced = reduce_rollout(self.roll)
+        action = next(iter(reduced.actions))
+        period_end = action.arrangement and None
+        # Build the expected settled output: evaluate the transfer, keep rest.
+        l = ledger(NOTARY)
+        end_day = next(p for p in action.arrangement.arrangements
+                       if isinstance(p, RollOut)).start_day
+        interest_amount = (to_quanta(1_000_000) * 5 * SCALE
+                           * (end_day - self.roll.start_day)) \
+            // (100 * SCALE * 360)
+        settled = all_of(
+            Transfer(Const(interest_amount), "USD", HIGH_ST, ACME),
+            next(p for p in action.arrangement.arrangements
+                 if isinstance(p, RollOut)))
+        with l.transaction() as tx:
+            tx.input(ustate(self.roll))
+            tx.output(None, ustate(settled))
+            tx.command(UAction("pay"), ACME.owning_key)
+            tx.timestamp(day_ts(end_day))
+            tx.verifies()
