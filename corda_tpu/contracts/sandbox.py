@@ -1,0 +1,329 @@
+"""Deterministic contract sandbox: static vetting + runtime cost accounting.
+
+Capability match for the reference's experimental deterministic-JVM sandbox
+(reference: experimental/sandbox/src/main/java/net/corda/sandbox/
+WhitelistClassLoader.java:21, CandidacyStatus.java, costing/
+RuntimeCostAccounter.java, costing/ContractExecutor.java): contract
+verification logic must be (a) *deterministic* — every node replaying the
+same transaction must reach the same verdict, so clocks, randomness, IO,
+process state and reflection are off limits — and (b) *bounded* — a hostile
+contract must not be able to stall a notary with an infinite loop or an
+allocation bomb.
+
+The reference enforces (a) by ASM-rewriting bytecode through a whitelist
+classloader and (b) by injecting cost-accounting call sites at every branch,
+allocation, invoke and throw. The Python equivalents used here:
+
+- **Static vetting** (`vet`): walk the contract's code objects with `dis`,
+  resolving every global/builtin reference and import. Only whitelisted
+  builtins, whitelisted modules (the ledger data model plus pure-math
+  stdlib), and code defined in whitelisted modules may be reached.
+  Forbidden names (``open``, ``eval``, ``exec``, ``globals``, ``id``,
+  ``hash``, …) and non-whitelisted imports fail vetting with the offending
+  name, mirroring WhitelistCheckingClassVisitor's reason codes.
+- **Runtime cost accounting** (`run`): execute under a ``sys.settrace``
+  tracer counting line transitions (the reference's *jump* cost), calls
+  (*invoke* cost) and raised exceptions (*throw* cost), plus a peak-memory
+  watermark via ``tracemalloc`` (*allocation* cost). Any budget breach
+  raises ``SandboxCostExceeded`` inside the traced frame, aborting
+  verification exactly like RuntimeCostAccounter's kill thresholds.
+
+Known limits (documented, as the reference's README documents its own):
+native builtins (e.g. ``sorted`` of a huge list) execute outside the line
+tracer, so their time is bounded only indirectly by the allocation budget;
+and set/dict *iteration order* over hash-randomised strings is not policed —
+ledger ids are immune because the canonical codec sorts by encoding.
+"""
+
+from __future__ import annotations
+
+import builtins
+import dis
+import sys
+import tracemalloc
+import types
+from dataclasses import dataclass
+
+from .structures import Contract
+from .verification import TransactionForContract
+
+
+class SandboxViolation(Exception):
+    """Static vetting failed: the code can reach a non-deterministic or
+    non-whitelisted facility (WhitelistClassloadingException equivalent)."""
+
+
+class SandboxCostExceeded(Exception):
+    """A runtime cost budget was breached (RuntimeCostAccounter kill)."""
+
+    def __init__(self, kind: str, spent: int, budget: int):
+        super().__init__(
+            f"contract exceeded its {kind} budget: {spent} > {budget}")
+        self.kind = kind
+        self.spent = spent
+        self.budget = budget
+
+
+@dataclass(frozen=True)
+class CostBudget:
+    """Kill thresholds (RuntimeCostAccounter.java BASELINE_*_KILL_THRESHOLD,
+    scaled for line-level rather than branch-level accounting)."""
+
+    jumps: int = 1_000_000  # line transitions
+    invokes: int = 200_000  # Python-level calls
+    throws: int = 50
+    alloc_bytes: int = 1 << 20  # 1 MiB peak above the starting watermark
+
+
+# Builtins a contract may use: pure, deterministic, side-effect free.
+ALLOWED_BUILTINS = frozenset({
+    "abs", "all", "any", "bin", "bool", "bytearray", "bytes", "callable",
+    "chr", "dict", "divmod", "enumerate", "filter", "float", "format",
+    "frozenset", "hex", "int", "isinstance",
+    "issubclass", "iter", "len", "list", "map", "max", "min", "next",
+    "object", "oct", "ord", "pow", "property", "range", "repr", "reversed",
+    "round", "set", "slice", "sorted", "staticmethod", "str", "sum", "super",
+    "tuple", "type", "zip",
+})
+
+# Explicitly banned names — each with the determinism/containment reason.
+FORBIDDEN_BUILTINS = frozenset({
+    "open", "input", "print",            # IO
+    "eval", "exec", "compile", "__import__",  # dynamic code loading
+    "globals", "locals", "vars", "dir",  # environment reflection
+    "getattr", "hasattr",                # string-named attribute access would
+                                         # bypass the FORBIDDEN_ATTRS check
+    "id", "hash",                        # address/seed dependent values
+    "memoryview", "breakpoint", "exit", "quit", "help",
+    "setattr", "delattr",                # state mutation outside the tx view
+})
+
+# Modules whose code a contract may call into. The ledger data model is
+# trusted (it is the platform), plus a small pure-math stdlib allowance.
+DEFAULT_MODULE_WHITELIST = (
+    "corda_tpu.contracts",
+    "corda_tpu.crypto",
+    "corda_tpu.finance",
+    "corda_tpu.serialization",
+    "corda_tpu.transactions",
+    "math", "cmath", "decimal", "fractions", "itertools", "functools",
+    "operator", "dataclasses", "enum", "typing", "abc", "numbers", "re",
+    "collections", "copy", "string",
+)
+
+# Reflection attributes that escape any static whitelist if reachable
+# (SandboxRemapper.java's rewrite targets, translated to CPython).
+FORBIDDEN_ATTRS = frozenset({
+    "__globals__", "__builtins__", "__code__", "__closure__", "__dict__",
+    "__subclasses__", "__getattribute__", "__reduce__", "__reduce_ex__",
+    "__loader__", "__spec__", "__import__", "gi_frame", "f_globals",
+})
+
+# Exception types are fine to reference (contracts raise to reject).
+_EXCEPTION_NAMES = frozenset(
+    n for n in dir(builtins)
+    if isinstance(getattr(builtins, n), type)
+    and issubclass(getattr(builtins, n), BaseException))
+
+
+def _module_allowed(name: str, whitelist: tuple[str, ...]) -> bool:
+    return any(name == w or name.startswith(w + ".") for w in whitelist)
+
+
+class DeterministicSandbox:
+    """Vets and executes contract verification code (ContractExecutor.java:
+    execute/isSuitable, with vetting transitive like WhitelistClassLoader's
+    candidacy resolution)."""
+
+    def __init__(self, budget: CostBudget = CostBudget(),
+                 module_whitelist: tuple[str, ...] = DEFAULT_MODULE_WHITELIST):
+        self.budget = budget
+        self.module_whitelist = tuple(module_whitelist)
+        self._vetted: set[types.CodeType] = set()
+
+    # ------------------------------------------------------------- vetting
+
+    def is_suitable(self, contract: Contract) -> bool:
+        """Non-raising form of vet (ContractExecutor.isSuitable)."""
+        try:
+            self.vet_contract(contract)
+            return True
+        except SandboxViolation:
+            return False
+
+    def vet_contract(self, contract: Contract) -> None:
+        self.vet(type(contract).verify)
+
+    def vet(self, fn) -> None:
+        """Statically verify every name `fn` can reach, transitively through
+        functions defined in non-whitelisted (i.e. user) modules. Functions
+        *defined in* whitelisted modules are trusted as-is (the platform is
+        the trust root, exactly as the reference's classloader trusts the
+        JDK/platform jars it doesn't rewrite)."""
+        fn = getattr(fn, "__func__", fn)
+        if _module_allowed(getattr(fn, "__module__", None) or "",
+                           self.module_whitelist):
+            return
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            raise SandboxViolation(f"not vettable: {fn!r}")
+        closure: dict = {}
+        for name, cell in zip(code.co_freevars, fn.__closure__ or ()):
+            try:
+                closure[name] = cell.cell_contents
+            except ValueError:
+                pass  # unbound cell; resolves to NameError at runtime
+        self._vet_code(code, getattr(fn, "__globals__", {}), closure)
+
+    def _vet_code(self, code: types.CodeType, globs: dict,
+                  closure: dict | None = None) -> None:
+        if code in self._vetted:
+            return
+        self._vetted.add(code)
+        where = f"{code.co_filename}:{code.co_name}"
+
+        for inst in dis.get_instructions(code):
+            if inst.opname in ("IMPORT_NAME", "IMPORT_FROM"):
+                mod = str(inst.argval)
+                if inst.opname == "IMPORT_NAME" and not _module_allowed(
+                        mod, self.module_whitelist):
+                    raise SandboxViolation(
+                        f"{where}: import of non-whitelisted module {mod!r}")
+            elif inst.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
+                self._vet_name(str(inst.argval), globs, where)
+            elif inst.opname == "LOAD_DEREF" and closure \
+                    and inst.argval in closure:
+                self._vet_value(str(inst.argval), closure[inst.argval], where)
+            elif inst.opname in ("LOAD_ATTR", "LOAD_METHOD"):
+                if str(inst.argval) in FORBIDDEN_ATTRS:
+                    raise SandboxViolation(
+                        f"{where}: access to reflection attribute "
+                        f"{inst.argval!r}")
+            elif inst.opname in ("STORE_GLOBAL", "DELETE_GLOBAL"):
+                # Persistent module-level state makes replays diverge.
+                raise SandboxViolation(
+                    f"{where}: mutation of global {inst.argval!r}")
+            elif inst.opname in ("STORE_ATTR", "DELETE_ATTR"):
+                # Contracts must treat the tx view (and anything reachable
+                # from it, including platform modules) as immutable.
+                raise SandboxViolation(
+                    f"{where}: attribute mutation {inst.argval!r}")
+
+        for const in code.co_consts:
+            if isinstance(const, types.CodeType):
+                self._vet_code(const, globs)
+
+    def _vet_name(self, name: str, globs: dict, where: str) -> None:
+        if name in FORBIDDEN_BUILTINS:
+            raise SandboxViolation(
+                f"{where}: use of forbidden builtin {name!r}")
+        if name in globs:
+            self._vet_value(name, globs[name], where)
+            return
+        if name in ALLOWED_BUILTINS or name in _EXCEPTION_NAMES:
+            return
+        if hasattr(builtins, name):
+            raise SandboxViolation(
+                f"{where}: builtin {name!r} is not whitelisted")
+        # A truly unresolvable name would NameError at runtime; fine.
+
+    def _vet_value(self, name: str, value, where: str) -> None:
+        if isinstance(value, types.ModuleType):
+            if not _module_allowed(value.__name__, self.module_whitelist):
+                raise SandboxViolation(
+                    f"{where}: reference to non-whitelisted module "
+                    f"{value.__name__!r} (as {name!r})")
+            return
+        mod = getattr(value, "__module__", None)
+        if mod is not None and _module_allowed(mod, self.module_whitelist):
+            return  # platform/whitelisted code is trusted as-is
+        if mod == "builtins":
+            vetted_name = getattr(value, "__name__", name)
+            self._vet_name(vetted_name, {}, where)
+            return
+        # User code from a non-whitelisted module: recurse into it.
+        if isinstance(value, (types.FunctionType, types.MethodType)):
+            self.vet(value)
+            return
+        if isinstance(value, type):
+            for attr in vars(value).values():
+                func = getattr(attr, "__func__", attr)
+                if isinstance(func, types.FunctionType):
+                    self.vet(func)
+            return
+        if isinstance(value, (int, float, str, bytes, bool, tuple, frozenset,
+                              complex)) or value is None:
+            return  # immutable constants
+        raise SandboxViolation(
+            f"{where}: global {name!r} of type {type(value).__name__} from "
+            f"non-whitelisted module {mod!r}")
+
+    # ----------------------------------------------------------- execution
+
+    def run(self, fn, *args, **kwargs):
+        """Vet, then execute under the cost tracer. Returns fn's result;
+        raises SandboxViolation / SandboxCostExceeded."""
+        self.vet(fn)
+        budget = self.budget
+        counts = {"jump": 0, "invoke": 0, "throw": 0}
+
+        def charge(kind: str, limit: int) -> None:
+            counts[kind] += 1
+            if counts[kind] > limit:
+                raise SandboxCostExceeded(kind, counts[kind], limit)
+
+        def check_alloc() -> None:
+            current, peak = tracemalloc.get_traced_memory()
+            if max(current, peak) - base > budget.alloc_bytes:
+                raise SandboxCostExceeded(
+                    "alloc", max(current, peak) - base, budget.alloc_bytes)
+
+        def tracer(frame, event, arg):
+            if event == "call":
+                charge("invoke", budget.invokes)
+                return tracer
+            if event == "line":
+                charge("jump", budget.jumps)
+                # Kill allocation bombs *mid-loop*, not after the damage is
+                # done; sampled so the common case stays cheap.
+                if counts["jump"] % 64 == 0:
+                    check_alloc()
+            elif event == "exception":
+                charge("throw", budget.throws)
+            return tracer
+
+        started_tracemalloc = not tracemalloc.is_tracing()
+        if started_tracemalloc:
+            tracemalloc.start()
+        else:
+            tracemalloc.reset_peak()
+        base, _ = tracemalloc.get_traced_memory()
+        old_trace = sys.gettrace()
+        sys.settrace(tracer)
+        try:
+            result = fn(*args, **kwargs)
+        finally:
+            sys.settrace(old_trace)
+            _, peak = tracemalloc.get_traced_memory()
+            if started_tracemalloc:
+                tracemalloc.stop()
+        allocated = max(0, peak - base)
+        if allocated > budget.alloc_bytes:
+            raise SandboxCostExceeded("alloc", allocated, budget.alloc_bytes)
+        return result
+
+    def execute(self, contract: Contract, tx: TransactionForContract) -> None:
+        """Run a contract's verify inside the sandbox
+        (ContractExecutor.execute)."""
+        self.run(type(contract).verify, contract, tx)
+
+
+def sandboxed_verify(tx: TransactionForContract,
+                     sandbox: DeterministicSandbox | None = None) -> None:
+    """Verify every contract referenced by a transaction inside one sandbox —
+    the drop-in hardened twin of platform contract verification."""
+    sandbox = sandbox or DeterministicSandbox()
+    contracts = {s.contract for s in tx.inputs} | {
+        s.contract for s in tx.outputs}
+    for contract in sorted(contracts, key=lambda c: type(c).__name__):
+        sandbox.execute(contract, tx)

@@ -1,0 +1,205 @@
+"""Deterministic-sandbox tests: vetting rejections + runtime cost kills.
+
+Mirrors the reference's sandbox test tier (reference: experimental/sandbox/
+src/test/java/net/corda/sandbox — whitelist-rejection and cost-instrumented
+execution checks) against real framework contracts.
+"""
+
+import time
+
+import pytest
+
+from corda_tpu.contracts.sandbox import (
+    CostBudget,
+    DeterministicSandbox,
+    SandboxCostExceeded,
+    SandboxViolation,
+    sandboxed_verify,
+)
+from corda_tpu.contracts.structures import Contract, Issued
+from corda_tpu.contracts.universal import UIssue
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.party import Party
+from corda_tpu.finance import Amount, CashState
+from corda_tpu.finance.cash import Cash, CashIssue
+from corda_tpu.testing.ledger_dsl import ledger
+
+ALICE = Party.of("Alice", KeyPair.generate(b"\x51" * 32).public)
+BANK = Party.of("Bank", KeyPair.generate(b"\x52" * 32).public)
+NOTARY = Party.of("Notary", KeyPair.generate(b"\x53" * 32).public)
+TOKEN = Issued(BANK.ref(b"\x01"), "USD")
+
+
+def issue_tx():
+    """A valid Cash issuance as a TransactionForContract."""
+    l = ledger(NOTARY)
+    with l.transaction() as tx:
+        tx.output("cash", CashState(Amount(1000, TOKEN), ALICE.owning_key))
+        tx.command(CashIssue(1), BANK.owning_key)
+        tx.verifies()
+        return tx._tx_for_contract()
+
+
+class TestVetting:
+    def test_platform_contracts_are_suitable(self):
+        sandbox = DeterministicSandbox()
+        assert sandbox.is_suitable(Cash())
+
+    def test_clock_access_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                if time.time() > 0:
+                    raise ValueError("nope")
+
+        with pytest.raises(SandboxViolation, match="time"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_io_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                open("/etc/passwd").read()
+
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_dynamic_code_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                eval("1 + 1")
+
+        with pytest.raises(SandboxViolation, match="eval"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_nonwhitelisted_import_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                import socket
+                socket.gethostname()
+
+        with pytest.raises(SandboxViolation, match="socket"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_reflection_escape_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                (lambda: 0).__globals__["__builtins__"]
+
+        with pytest.raises(SandboxViolation, match="__globals__"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_transitive_helper_is_vetted(self):
+        def helper():
+            return time.time()
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                helper()
+
+        with pytest.raises(SandboxViolation, match="time"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_nested_code_objects_are_vetted(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                def inner():
+                    return open("x")
+                return inner
+
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_getattr_escape_rejected(self):
+        # getattr("__globals__") would bypass the LOAD_ATTR check entirely.
+        class EvilContract(Contract):
+            def verify(self, tx):
+                g = getattr(self.verify, "__glo" + "bals__")
+                return g
+
+        with pytest.raises(SandboxViolation, match="getattr"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_global_mutation_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                global _leak
+                _leak = tx  # persists across verifications
+
+        with pytest.raises(SandboxViolation, match="global"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_attribute_mutation_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                tx.inputs = ()  # monkey-patching the tx view
+
+        with pytest.raises(SandboxViolation, match="mutation"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_nondeterministic_builtins_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return id(tx)
+
+        with pytest.raises(SandboxViolation, match="id"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+
+class TestCostAccounting:
+    def test_infinite_loop_killed(self):
+        def spin():
+            n = 0
+            while True:
+                n += 1
+
+        sandbox = DeterministicSandbox(budget=CostBudget(jumps=10_000))
+        with pytest.raises(SandboxCostExceeded) as e:
+            sandbox.run(spin)
+        assert e.value.kind == "jump"
+
+    def test_call_bomb_killed(self):
+        def fanout(depth=0):
+            for _ in range(50):
+                if depth < 50:
+                    fanout(depth + 1)
+
+        sandbox = DeterministicSandbox(budget=CostBudget(invokes=1_000))
+        with pytest.raises(SandboxCostExceeded) as e:
+            sandbox.run(fanout)
+        assert e.value.kind == "invoke"
+
+    def test_allocation_bomb_killed(self):
+        def hoard():
+            return [bytes(1024) for _ in range(64 * 1024)]
+
+        sandbox = DeterministicSandbox(
+            budget=CostBudget(alloc_bytes=1 << 20, jumps=10**9))
+        with pytest.raises(SandboxCostExceeded) as e:
+            sandbox.run(hoard)
+        assert e.value.kind == "alloc"
+
+    def test_throw_storm_killed(self):
+        def storm():
+            for _ in range(200):
+                try:
+                    raise ValueError("x")
+                except ValueError:
+                    pass
+
+        sandbox = DeterministicSandbox(budget=CostBudget(throws=50))
+        with pytest.raises(SandboxCostExceeded) as e:
+            sandbox.run(storm)
+        assert e.value.kind == "throw"
+
+    def test_well_behaved_contract_passes(self):
+        tx = issue_tx()
+        sandboxed_verify(tx)  # Cash.verify under default budgets
+
+    def test_rejection_propagates_unchanged(self):
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.output(None, CashState(Amount(1000, TOKEN), ALICE.owning_key))
+            tx.command(CashIssue(1), ALICE.owning_key)  # not the issuer
+            bad = tx._tx_for_contract()
+            tx.fails_with("issuer")
+        with pytest.raises(Exception, match="issuer"):
+            sandboxed_verify(bad)
