@@ -1,0 +1,272 @@
+"""Explorer: the operations dashboard, served as a web page over RPC.
+
+Capability match for the reference's explorer tool (reference:
+tools/explorer/src/main/kotlin/net/corda/explorer/Main.kt — a TornadoFX/
+JavaFX GUI whose views are CashViewer, transaction viewer and network
+identity lists, all fed by the client RPC observables via NodeMonitorModel,
+client/src/main/kotlin/net/corda/client/model/NodeMonitorModel.kt).
+
+TPU-framework form: the node side is identical (everything rides the RPC
+surface: vault/network/state-machine snapshots plus the ``state_machine_
+changes`` cursor stream), but the presentation tier is a dependency-free web
+dashboard instead of a desktop JavaFX shell — an http.server endpoint that
+renders one self-refreshing HTML page and exposes the same data as JSON
+(``/api/dashboard``) for headless consumers. The JFX observable models
+(ContractStateModel's cash rollup, GatheredTransactionDataModel's tx list,
+NodeMonitorModel's flow progress feed) map to the ``gather()`` aggregation
+below: cash balances grouped by currency, recent transactions, in-flight
+flows with progress, node metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto.hashes import SecureHash
+from ..crypto.party import Party
+from ..node.rpc import RpcClient
+
+
+def render_value(obj, depth: int = 0):
+    """Recursively turn ledger objects into plain JSON-able structures.
+    The explorer displays *everything* the RPC surface returns, so this is
+    deliberately generic: dataclasses become tagged dicts, keys/hashes render
+    as short strings, and depth is capped against adversarial nesting."""
+    if depth > 12:
+        return "…"
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return obj.hex() if len(obj) <= 64 else f"{len(obj)} bytes"
+    if isinstance(obj, SecureHash):
+        return obj.prefix_chars(12)
+    if isinstance(obj, Party):
+        return str(obj.name)
+    from ..transactions.signed import SignedTransaction
+
+    if isinstance(obj, SignedTransaction):
+        # Render the deserialized wire transaction, not the opaque tx_bits
+        # (the GUI explorer's transaction viewer shows components).
+        return {"_type": "SignedTransaction",
+                "id": render_value(obj.id, depth + 1),
+                "tx": render_value(obj.tx, depth + 1),
+                "sigs": render_value(obj.sigs, depth + 1)}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {"_type": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = render_value(getattr(obj, f.name), depth + 1)
+        return out
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        items = [render_value(x, depth + 1) for x in obj]
+        if isinstance(obj, (set, frozenset)):
+            items.sort(key=json.dumps)
+        return items
+    if isinstance(obj, dict):
+        return {str(k): render_value(v, depth + 1) for k, v in obj.items()}
+    to_render = getattr(obj, "__dict__", None)
+    if to_render is not None:
+        return {"_type": type(obj).__name__,
+                **{k: render_value(v, depth + 1)
+                   for k, v in to_render.items() if not k.startswith("_")}}
+    return repr(obj)
+
+
+def cash_balances(vault_states) -> dict[str, int]:
+    """ContractStateModel.kt's cash rollup: sum CashState quantities per
+    currency code across the unconsumed set."""
+    from ..finance import CashState
+
+    balances: dict[str, int] = {}
+    for sref in vault_states:
+        data = getattr(getattr(sref, "state", sref), "data", None)
+        if isinstance(data, CashState):
+            currency = data.amount.token.product
+            balances[str(currency)] = (
+                balances.get(str(currency), 0) + data.amount.quantity)
+    return balances
+
+
+class ExplorerModel:
+    """The data-gathering half (NodeMonitorModel.kt capability): aggregates
+    every RPC feed into one dashboard snapshot, tracking the state-machine
+    change cursor across polls so flow history accumulates client-side."""
+
+    MAX_TX, MAX_EVENTS = 50, 200
+
+    def __init__(self, rpc: RpcClient):
+        self.rpc = rpc
+        self._cursor = 0
+        self._events: list = []
+
+    def gather(self) -> dict:
+        rpc = self.rpc
+        identity = rpc.call("node_identity")
+        network = rpc.call("network_map_snapshot")
+        vault = rpc.call("vault_snapshot")
+        in_flight = rpc.call("state_machines_snapshot")
+        metrics = rpc.call("node_metrics")
+        self._cursor, new_events = rpc.call(
+            "state_machine_changes", self._cursor)
+        self._events.extend(new_events)
+        del self._events[:-self.MAX_EVENTS]
+
+        transactions = []
+        seen = set()
+        for sref in vault:
+            ref = getattr(sref, "ref", None)
+            txhash = getattr(ref, "txhash", None)
+            if txhash is None or txhash in seen:
+                continue
+            seen.add(txhash)
+            stx = rpc.call("verified_transaction", txhash)
+            if stx is not None:
+                transactions.append(stx)
+            if len(transactions) >= self.MAX_TX:
+                break
+
+        return {
+            "identity": render_value(identity),
+            "network": render_value(network),
+            "balances": cash_balances(vault),
+            "vault": render_value(vault),
+            "transactions": render_value(transactions),
+            "flows_in_flight": render_value(in_flight),
+            "flow_events": render_value(self._events),
+            "metrics": render_value(metrics),
+        }
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>corda_tpu explorer</title><style>
+ body { font-family: system-ui, sans-serif; margin: 2em; color: #222; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #ccc; padding: 4px 10px; font-size: 0.85em;
+          text-align: left; vertical-align: top; }
+ pre { background: #f6f6f6; padding: 8px; font-size: 0.8em;
+       max-height: 22em; overflow: auto; }
+ .muted { color: #777; }
+</style></head><body>
+<h1>corda_tpu explorer <span class="muted" id="who"></span></h1>
+<h2>Cash balances</h2><table id="balances"></table>
+<h2>Network</h2><table id="network"></table>
+<h2>Flows in flight</h2><pre id="flows"></pre>
+<h2>Recent flow events</h2><pre id="events"></pre>
+<h2>Vault (unconsumed states)</h2><pre id="vault"></pre>
+<h2>Recent transactions</h2><pre id="txs"></pre>
+<h2>Node metrics</h2><table id="metrics"></table>
+<script>
+function rows(el, pairs) {
+  // Ledger data (party names, currency codes) is attacker-influenced:
+  // build DOM nodes so it can never execute as HTML.
+  el.replaceChildren(...pairs.map(p => {
+    const tr = document.createElement("tr");
+    const th = document.createElement("th");
+    const td = document.createElement("td");
+    th.textContent = String(p[0]);
+    td.textContent = String(p[1]);
+    tr.append(th, td);
+    return tr;
+  }));
+}
+async function refresh() {
+  const r = await fetch("/api/dashboard");
+  if (!r.ok) return;
+  const d = await r.json();
+  document.getElementById("who").textContent = "— " + d.identity;
+  rows(document.getElementById("balances"), Object.entries(d.balances));
+  rows(document.getElementById("network"),
+       d.network.map(n => [n.legal_identity ?? JSON.stringify(n),
+                           JSON.stringify(n.address)]));
+  rows(document.getElementById("metrics"), Object.entries(d.metrics));
+  document.getElementById("flows").textContent =
+      JSON.stringify(d.flows_in_flight, null, 1);
+  document.getElementById("events").textContent =
+      JSON.stringify(d.flow_events.slice(-40), null, 1);
+  document.getElementById("vault").textContent =
+      JSON.stringify(d.vault, null, 1);
+  document.getElementById("txs").textContent =
+      JSON.stringify(d.transactions, null, 1);
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class ExplorerServer:
+    """HTTP shell around ExplorerModel (the Main.kt/TornadoFX equivalent)."""
+
+    def __init__(self, rpc: RpcClient, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.model = ExplorerModel(rpc)
+        model = self.model
+        lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path == "/":
+                        body, ctype = _PAGE.encode(), "text/html"
+                    elif self.path == "/api/dashboard":
+                        with lock:  # one RPC conversation at a time
+                            body = json.dumps(model.gather()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # pragma: no cover - network races
+                    try:
+                        self.send_error(500, str(e)[:200])
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2)
+
+
+def main(argv=None) -> None:
+    from ..node.messaging.tcp import TcpAddress
+
+    parser = argparse.ArgumentParser(
+        description="Web explorer for a running corda_tpu node")
+    parser.add_argument("node", help="node RPC address, host:port")
+    parser.add_argument("user")
+    parser.add_argument("password")
+    parser.add_argument("--listen", type=int, default=8880,
+                        help="dashboard port (default 8880)")
+    args = parser.parse_args(argv)
+    host, _, port = args.node.partition(":")
+    rpc = RpcClient(TcpAddress(host, int(port)), args.user, args.password)
+    server = ExplorerServer(rpc, port=args.listen)
+    print(f"explorer on http://{server.address[0]}:{server.address[1]}/")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        rpc.close()
+
+
+if __name__ == "__main__":
+    main()
