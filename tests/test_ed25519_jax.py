@@ -178,3 +178,32 @@ def test_shadow_sampling_detects_kernel_divergence(monkeypatch):
                         lambda *a, **k: ~real(*a, **k))
     with pytest.raises(RuntimeError, match="divergence"):
         JaxVerifier(shadow_rate=1.0).verify_batch(jobs)
+
+
+def test_verify_stream_matches_oracle_across_batches():
+    """The double-buffered pipeline must return per-batch results in order,
+    bit-identical to the oracle, including mixed valid/invalid rows and
+    varying batch sizes."""
+    from corda_tpu.crypto import ref_ed25519 as ref
+    from corda_tpu.ops import ed25519_jax
+
+    batches, expects = [], []
+    for b, size in enumerate((5, 9, 3)):
+        pks, msgs, sigs, expect = [], [], [], []
+        for i in range(size):
+            sk = bytes([b * 16 + i + 1]) * 32
+            pk = ref.public_key(sk)
+            m = b"stream-%d-%d" % (b, i)
+            s = ref.sign(sk, m)
+            ok = (i + b) % 3 != 2
+            if not ok:
+                s = s[:7] + bytes([s[7] ^ 0x20]) + s[8:]
+            pks.append(pk)
+            msgs.append(m)
+            sigs.append(s)
+            expect.append(ok)
+        batches.append((pks, msgs, sigs))
+        expects.append(expect)
+
+    outs = list(ed25519_jax.verify_stream(iter(batches), bucket=16))
+    assert [o.tolist() for o in outs] == expects
