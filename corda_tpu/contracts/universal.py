@@ -488,9 +488,15 @@ def _substitute(p: Perceivable, mapping) -> Perceivable:
     return p if replacement is None else replacement
 
 
-def _map_arrangement(arrangement: Arrangement, p_map, a_map) -> Arrangement:
+def _map_arrangement(arrangement: Arrangement, p_map, a_map,
+                     into_rollout: bool = True) -> Arrangement:
     """Rebuild an arrangement tree applying p_map to every perceivable and
-    a_map to every arrangement node (bottom-up)."""
+    a_map to every arrangement node (bottom-up). ``into_rollout=False`` stops
+    at nested RollOut boundaries: their StartDate/EndDate/Continuation
+    placeholders belong to the *inner* schedule's scope, so period
+    substitution must not rewrite them (fixing substitution must — the
+    reference's replaceFixing recurses into RollOut templates while
+    replaceStartEnd does not, UniversalContract.kt:124-146,286)."""
     if isinstance(arrangement, (Zero, Continuation)):
         out: Arrangement = arrangement
     elif isinstance(arrangement, Transfer):
@@ -498,17 +504,22 @@ def _map_arrangement(arrangement: Arrangement, p_map, a_map) -> Arrangement:
                        arrangement.currency, arrangement.from_party,
                        arrangement.to_party)
     elif isinstance(arrangement, All):
-        out = All(frozenset(_map_arrangement(a, p_map, a_map)
-                            for a in arrangement.arrangements))
+        out = All(frozenset(
+            _map_arrangement(a, p_map, a_map, into_rollout)
+            for a in arrangement.arrangements))
     elif isinstance(arrangement, Actions):
         out = Actions(frozenset(
             Action(a.name, _substitute(a.condition, p_map), a.actors,
-                   _map_arrangement(a.arrangement, p_map, a_map))
+                   _map_arrangement(a.arrangement, p_map, a_map,
+                                    into_rollout))
             for a in arrangement.actions))
     elif isinstance(arrangement, RollOut):
+        if not into_rollout:
+            return arrangement
         out = RollOut(arrangement.start_day, arrangement.end_day,
                       arrangement.frequency,
-                      _map_arrangement(arrangement.template, p_map, a_map))
+                      _map_arrangement(arrangement.template, p_map, a_map,
+                                       into_rollout))
     else:
         raise TypeError(f"map_arrangement: {type(arrangement).__name__}")
     replacement = a_map(out)
@@ -544,7 +555,7 @@ def reduce_rollout(roll: RollOut,
             return all_of(*a.arrangements)
         return None
 
-    return _map_arrangement(roll.template, p_map, a_map)
+    return _map_arrangement(roll.template, p_map, a_map, into_rollout=False)
 
 
 def replace_fixings(arrangement: Arrangement, fixes: dict[FixOf, int],

@@ -337,6 +337,112 @@ class TestFixings:
         assert amount == expected > 0
 
 
+class TestIRS:
+    """Full interest-rate-swap cashflow schedule on the universal DSL
+    (reference: experimental/.../universal/IRS.kt contractInitial /
+    contractAfterFixingFirst / contractAfterExecutionFirst), driven through
+    the ledger for two periods: fix -> net-settle -> roll -> fix again."""
+
+    START = date_to_days(dt.date(2016, 9, 1))
+    END = date_to_days(dt.date(2018, 9, 1))
+
+    def setup_method(self):
+        from corda_tpu.finance.irs import interest_rate_swap
+
+        self.swap = interest_rate_swap(
+            notional=to_quanta(50_000_000), currency="EUR",
+            fixed_rate=SCALE // 2,  # 0.5%
+            floating_index="LIBOR", index_tenor="3M", oracle=MOMENTUM,
+            fixed_leg_payer=ACME, floating_leg_payer=HIGH_ST,
+            start_day=self.START, end_day=self.END, frequency=Tenor("3M"))
+
+    def _fix_of(self, day):
+        return FixOf("LIBOR", day, "3M")
+
+    def test_two_period_lifecycle_on_ledger(self):
+        from corda_tpu.contracts.universal import actions_of
+
+        l = ledger(NOTARY)
+        # --- period 1: apply the oracle fixing (LIBOR = 1.0%)
+        fixes1 = {self._fix_of(self.START): SCALE}
+        fixed1 = replace_fixings(reduce_rollout(self.swap), fixes1)
+        with l.transaction() as tx:
+            tx.input(ustate(self.swap))
+            tx.output("fixed-1", ustate(fixed1))
+            tx.command(UApplyFixes((Fix(self._fix_of(self.START), SCALE),)),
+                       ACME.owning_key)
+            tx.command(Fix(self._fix_of(self.START), SCALE),
+                       MOMENTUM.owning_key)
+            tx.verifies()
+
+        # --- period 1: floating (1.0%) > fixed (0.5%): HighSt pays the net
+        action = actions_of(fixed1)["settle"]
+        parts = set(action.arrangement.arrangements)
+        pays = [p for p in parts if isinstance(p, Transfer)]
+        rest = next(p for p in parts if isinstance(p, RollOut))
+        to_acme = next(p for p in pays if p.to_party == ACME)
+        to_highst = next(p for p in pays if p.to_party == HIGH_ST)
+        net = eval_amount(None, to_acme.amount)
+        days1 = rest.start_day - self.START
+        assert net == (to_quanta(50_000_000) * (SCALE // 2) * days1) \
+            // (100 * SCALE * 365) > 0
+        assert eval_amount(None, to_highst.amount) == 0
+        settled = Transfer(Const(net), "EUR", HIGH_ST, ACME)
+        zero_leg = Transfer(Const(0), "EUR", ACME, HIGH_ST)
+        with l.transaction() as tx:
+            tx.input("fixed-1")
+            tx.output("settled-1", ustate(settled))
+            tx.output(None, ustate(zero_leg))
+            tx.output("rest", ustate(rest))
+            tx.command(UAction("settle"), HIGH_ST.owning_key)
+            tx.timestamp(day_ts(rest.start_day))
+            # the debtor cannot discharge the period while omitting the net
+            # payment: output must carry BOTH evaluated legs
+            with tx.tweak() as tw:
+                tw.outputs = [o for o in tw.outputs
+                              if o[1].details != settled]
+                tw.fails_with("match action result")
+            tx.verifies()
+
+        # the rolled remainder still owns its placeholders (inner scope)
+        assert rest.template == self.swap.template
+
+        # --- period 2: the remaining schedule fixes independently
+        fixes2 = {self._fix_of(rest.start_day): SCALE // 4}  # 0.25%
+        fixed2 = replace_fixings(reduce_rollout(rest), fixes2)
+        with l.transaction() as tx:
+            tx.input("rest")
+            tx.output("fixed-2", ustate(fixed2))
+            tx.command(
+                UApplyFixes((Fix(self._fix_of(rest.start_day), SCALE // 4),)),
+                ACME.owning_key)
+            tx.command(Fix(self._fix_of(rest.start_day), SCALE // 4),
+                       MOMENTUM.owning_key)
+            tx.verifies()
+
+        # period 2: fixed (0.5%) > floating (0.25%): the net now flows the
+        # other way — ACME pays HighSt — out of the same single settle action
+        action2 = actions_of(fixed2)["settle"]
+        pays2 = [p for p in set(action2.arrangement.arrangements)
+                 if isinstance(p, Transfer)]
+        to_highst2 = next(p for p in pays2 if p.to_party == HIGH_ST)
+        to_acme2 = next(p for p in pays2 if p.to_party == ACME)
+        assert eval_amount(None, to_highst2.amount) > 0
+        assert eval_amount(None, to_acme2.amount) == 0
+
+    def test_fixing_with_wrong_oracle_rejected_for_irs(self):
+        fixes = {self._fix_of(self.START): SCALE}
+        fixed = replace_fixings(reduce_rollout(self.swap), fixes)
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.swap))
+            tx.output(None, ustate(fixed))
+            tx.command(UApplyFixes((Fix(self._fix_of(self.START), SCALE),)),
+                       ACME.owning_key)
+            tx.command(Fix(self._fix_of(self.START), SCALE), ACME.owning_key)
+            tx.fails_with("attested")
+
+
 class TestRollOut:
     """reference: RollOutTests.kt — schedules expand one period at a time."""
 
