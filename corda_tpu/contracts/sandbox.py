@@ -78,7 +78,8 @@ class CostBudget:
 # Builtins a contract may use: pure, deterministic, side-effect free.
 ALLOWED_BUILTINS = frozenset({
     "abs", "all", "any", "bin", "bool", "bytearray", "bytes", "callable",
-    "chr", "dict", "divmod", "enumerate", "filter", "float", "format",
+    "chr", "classmethod", "dict", "divmod", "enumerate", "filter", "float",
+    "format",
     "frozenset", "hex", "int", "isinstance",
     "issubclass", "iter", "len", "list", "map", "max", "min", "next",
     "object", "oct", "ord", "pow", "property", "range", "repr", "reversed",
@@ -90,7 +91,9 @@ ALLOWED_BUILTINS = frozenset({
 FORBIDDEN_BUILTINS = frozenset({
     "open", "input", "print",            # IO
     "eval", "exec", "compile", "__import__",  # dynamic code loading
-    "globals", "locals", "vars", "dir",  # environment reflection
+    "globals", "locals", "vars", "dir", "__builtins__",  # environment
+                                         # reflection (subscripting
+                                         # __builtins__ reaches everything)
     "getattr", "hasattr",                # string-named attribute access would
                                          # bypass the FORBIDDEN_ATTRS check
     "id", "hash",                        # address/seed dependent values
@@ -222,6 +225,9 @@ class DeterministicSandbox:
             return
         if name in ALLOWED_BUILTINS or name in _EXCEPTION_NAMES:
             return
+        if name in ("__name__", "__qualname__", "__module__", "__doc__",
+                    "__debug__", "__build_class__"):
+            return  # interpreter-supplied metadata in class/module bodies
         if hasattr(builtins, name):
             raise SandboxViolation(
                 f"{where}: builtin {name!r} is not whitelisted")

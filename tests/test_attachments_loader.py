@@ -1,0 +1,152 @@
+"""Attachments-as-code loading: the AttachmentsClassLoader equivalent.
+
+Mirrors the reference's AttachmentClassLoaderTests (reference:
+core/src/test/kotlin/net/corda/core/contracts/clauses? — the classloader
+suite at core/src/test, overlap rejection + class/resource loading), with
+the added guarantee the reference left as a TODO: attachment code is
+sandbox-vetted before execution.
+"""
+
+import pytest
+
+from corda_tpu.contracts.attachments_loader import (
+    AttachmentsModuleLoader,
+    OverlappingAttachments,
+    make_attachment_zip,
+)
+from corda_tpu.contracts.sandbox import SandboxViolation
+from corda_tpu.contracts.structures import Attachment
+from corda_tpu.crypto.hashes import SecureHash
+
+
+class BlobAttachment(Attachment):
+    def __init__(self, data: bytes):
+        self._data = data
+
+    @property
+    def id(self) -> SecureHash:
+        return SecureHash.sha256(self._data)
+
+    def open(self) -> bytes:
+        return self._data
+
+
+GOOD_CONTRACT = b"""
+from dataclasses import dataclass
+
+from corda_tpu.contracts.structures import Contract, ContractState
+from corda_tpu.contracts.dsl import require_that
+from helpers import MAGIC
+
+class ShippedContract(Contract):
+    def verify(self, tx):
+        with require_that() as req:
+            req("exactly one output", len(tx.outputs) == 1)
+            req("magic matches", MAGIC == 42)
+"""
+
+HELPERS = b"MAGIC = 42\n"
+
+
+def loader_for(files, extra=()):
+    blobs = [BlobAttachment(make_attachment_zip(files))]
+    for f in extra:
+        blobs.append(BlobAttachment(make_attachment_zip(f)))
+    return AttachmentsModuleLoader(blobs)
+
+
+def test_load_contract_and_sibling_import():
+    loader = loader_for({"shipped.py": GOOD_CONTRACT,
+                         "helpers.py": HELPERS,
+                         "docs/legal.txt": b"prose"})
+    contract = loader.load_contract("shipped.ShippedContract")
+    assert type(contract).__name__ == "ShippedContract"
+    assert loader.get_resource("docs/legal.txt") == b"prose"
+
+    from corda_tpu.contracts.verification import TransactionForContract
+    from corda_tpu.testing.dummies import DummySingleOwnerState
+
+    tx = TransactionForContract(
+        inputs=(), outputs=(DummySingleOwnerState(0),), attachments=(),
+        commands=(), id=SecureHash.random(), notary=None)
+    contract.verify(tx)  # one output, MAGIC == 42 -> accepts
+    bad = TransactionForContract(
+        inputs=(), outputs=(), attachments=(), commands=(),
+        id=SecureHash.random(), notary=None)
+    with pytest.raises(Exception, match="one output"):
+        contract.verify(bad)
+
+
+def test_overlapping_paths_rejected():
+    with pytest.raises(OverlappingAttachments, match="helpers.py"):
+        loader_for({"helpers.py": HELPERS},
+                   extra=[{"helpers.py": b"MAGIC = 13\n"}])
+
+
+def test_case_variant_paths_rejected():
+    with pytest.raises(OverlappingAttachments):
+        loader_for({"Helpers.py": HELPERS},
+                   extra=[{"helpers.py": HELPERS}])
+
+
+def test_missing_module_raises_module_not_found():
+    loader = loader_for({"helpers.py": HELPERS})
+    with pytest.raises(ModuleNotFoundError):
+        loader.load_module("nope")
+
+
+def test_hostile_attachment_rejected_at_load_time():
+    evil = b"import socket\nHOST = socket.gethostname()\n"
+    loader = loader_for({"evil.py": evil})
+    with pytest.raises(SandboxViolation, match="socket"):
+        loader.load_module("evil")
+
+
+def test_hostile_builtin_rejected_at_load_time():
+    evil = b"secret = open('/etc/passwd').read()\n"
+    loader = loader_for({"evil.py": evil})
+    with pytest.raises(SandboxViolation, match="open"):
+        loader.load_module("evil")
+
+
+def test_builtins_subscript_escape_rejected():
+    # __builtins__['open'] would bypass every attribute/name check.
+    evil = b"LEAK = __builtins__['open']\n"
+    loader = loader_for({"evil.py": evil})
+    with pytest.raises(SandboxViolation, match="__builtins__"):
+        loader.load_module("evil")
+
+
+def test_stub_shadowing_host_package_rejected():
+    # Shipping an empty os.py must not whitelist the REAL os package for
+    # dotted imports.
+    files = {"os.py": b"STUB = 1\n",
+             "evil.py": b"from os.path import exists\nHIT = exists('/')\n"}
+    loader = loader_for(files)
+    with pytest.raises(SandboxViolation, match="os.path"):
+        loader.load_module("evil")
+
+
+def test_attachment_builtins_are_restricted():
+    # Defence in depth: even at runtime the module's builtins expose only
+    # the sandbox whitelist — no open/eval/exec to find dynamically.
+    loader = loader_for({"helpers.py": HELPERS})
+    module = loader.load_module("helpers")
+    b = module.__dict__["__builtins__"]
+    assert "open" not in b and "eval" not in b and "exec" not in b
+    assert "len" in b and "ValueError" in b
+
+
+def test_runtime_import_escape_rejected():
+    # Vetting is static; the __import__ shim is the runtime backstop for
+    # anything reached dynamically.
+    sneaky = b"from helpers import MAGIC\n"
+    loader = loader_for({"sneaky.py": sneaky})  # helpers.py absent
+    with pytest.raises((SandboxViolation, ModuleNotFoundError)):
+        loader.load_module("sneaky")
+
+
+def test_loaded_contract_is_not_a_contract_type_error():
+    loader = loader_for({"helpers.py": HELPERS})
+    with pytest.raises(TypeError):
+        loader.load_contract("helpers.MAGIC")
