@@ -101,6 +101,9 @@ class ExplorerModel:
         self.rpc = rpc
         self._cursor = 0
         self._events: list = []
+        # Transactions are immutable and content-addressed: fetch each hash
+        # over RPC once, ever, instead of ~MAX_TX round trips per poll.
+        self._tx_cache: dict = {}
 
     def gather(self) -> dict:
         rpc = self.rpc
@@ -122,11 +125,18 @@ class ExplorerModel:
             if txhash is None or txhash in seen:
                 continue
             seen.add(txhash)
-            stx = rpc.call("verified_transaction", txhash)
+            if txhash not in self._tx_cache:
+                self._tx_cache[txhash] = rpc.call(
+                    "verified_transaction", txhash)
+            stx = self._tx_cache[txhash]
             if stx is not None:
                 transactions.append(stx)
             if len(transactions) >= self.MAX_TX:
                 break
+        # Bound the cache to hashes still referenced by the vault.
+        if len(self._tx_cache) > 4 * self.MAX_TX:
+            self._tx_cache = {h: s for h, s in self._tx_cache.items()
+                              if h in seen}
 
         return {
             "identity": render_value(identity),
