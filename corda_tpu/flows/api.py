@@ -122,6 +122,21 @@ class VerifyTxRequest:
 
 
 @dataclass(frozen=True)
+class VerifySigRequest:
+    """Check ONE raw signature through the same micro-batched verifier — the
+    single-signature sibling of VerifyTxRequest. Used where a flow validates
+    a counterparty's or notary's signature over known content (reference:
+    NotaryFlow.kt:58-80 validateSignature); riding the pump means N
+    concurrent flows validate their responses in one kernel call instead of
+    N sequential host-oracle scalar multiplications."""
+
+    pubkey: bytes
+    message: bytes
+    sig_bytes: bytes
+    description: str = ""
+
+
+@dataclass(frozen=True)
 class ServiceRequest:
     """Suspend on an asynchronous node service (e.g. the Raft commit log):
     `start()` launches the operation and returns a poll callable; the node's
@@ -231,6 +246,13 @@ class FlowLogic:
         self, stx: "SignedTransaction", *allowed_to_be_missing: CompositeKey
     ) -> VerifyTxRequest:
         return VerifyTxRequest(stx, tuple(allowed_to_be_missing))
+
+    def verify_signature_batched(self, sig, content: bytes) -> VerifySigRequest:
+        """Validate one signature over `content` via the verify pump
+        (`yield` it; raises SignatureError on mismatch when resumed)."""
+        return VerifySigRequest(
+            bytes(sig.by.encoded), bytes(content), bytes(sig.bytes),
+            description=f"by {sig.by}")
 
     def service_request(self, start: Callable) -> ServiceRequest:
         """Suspend on an async node service; see ServiceRequest."""

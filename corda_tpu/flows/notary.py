@@ -163,7 +163,10 @@ class NotaryClientFlow(FlowLogic):
             sig = result.sig
             if sig.by not in notary_party.owning_key.keys:
                 raise FlowException("Invalid signer for the notary result")
-            sig.verify(self.stx.id.bytes)
+            # Validate through the verify pump: N concurrent clients share
+            # one kernel call instead of N host-oracle verifications
+            # (reference: NotaryFlow.kt:58-80 validateSignature, sequential).
+            yield self.verify_signature_batched(sig, self.stx.id.bytes)
             return sig
         if isinstance(result, NotaryFailure):
             if isinstance(result.error, NotaryConflict):

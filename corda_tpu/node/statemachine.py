@@ -49,6 +49,7 @@ from ..flows.api import (
     SendRequest,
     ServiceRequest,
     UntrustworthyData,
+    VerifySigRequest,
     VerifyTxRequest,
     flow_registry,
 )
@@ -499,7 +500,7 @@ class FlowStateMachine:
         if isinstance(request, ReceiveRequest):
             self.get_or_open_session(request.party, request.scope, request.flow_name)
             return self._park_receive(request)
-        if isinstance(request, VerifyTxRequest):
+        if isinstance(request, (VerifyTxRequest, VerifySigRequest)):
             if self.replaying:
                 # Completed before the crash — replay the recorded outcome.
                 return self._consume_replay_entry()
@@ -858,12 +859,18 @@ class StateMachineManager:
 
     # -- the verification pump (TPU seam) ---------------------------------
 
-    def _enqueue_verify(self, fsm: FlowStateMachine, request: VerifyTxRequest) -> None:
+    def _enqueue_verify(
+        self, fsm: FlowStateMachine,
+        request: "VerifyTxRequest | VerifySigRequest",
+    ) -> None:
         if not self._verify_queue:
             import time as _time
 
             self._verify_waiting_since = _time.monotonic()
         self._verify_queue.append((fsm, request))
+        if isinstance(request, VerifySigRequest):
+            self._verify_sig_count += 1
+            return
         # Count at least 1 per request: a zero-signature request (can't arise
         # from SignedTransaction today, which demands >=1 sig, but belt-and-
         # braces) must still trip the flush gate or its flow parks forever.
@@ -911,28 +918,40 @@ class StateMachineManager:
         return done
 
     def _flush_verify_batch(self) -> None:
-        """One batched kernel call covering every parked VerifyTxRequest."""
+        """One batched kernel call covering every parked VerifyTxRequest and
+        VerifySigRequest."""
         batch, self._verify_queue = self._verify_queue, []
         self._verify_sig_count = 0
         jobs: list[VerifyJob] = []
-        spans: list[tuple[FlowStateMachine, VerifyTxRequest, int, int]] = []
+        spans: list[tuple[FlowStateMachine, Any, int, int]] = []
         for fsm, request in batch:
-            sigs = request.stx.sigs
             start = len(jobs)
-            jobs.extend(
-                VerifyJob(
-                    pubkey=sig.by.encoded,
-                    message=request.stx.id.bytes,
-                    sig=sig.bytes,
+            if isinstance(request, VerifySigRequest):
+                jobs.append(VerifyJob(
+                    pubkey=request.pubkey, message=request.message,
+                    sig=request.sig_bytes))
+            else:
+                jobs.extend(
+                    VerifyJob(
+                        pubkey=sig.by.encoded,
+                        message=request.stx.id.bytes,
+                        sig=sig.bytes,
+                    )
+                    for sig in request.stx.sigs
                 )
-                for sig in sigs
-            )
             spans.append((fsm, request, start, len(jobs)))
         ok = self.verifier.verify_batch(jobs) if jobs else []
         self.metrics["verify_batches"] += 1
         self.metrics["verify_sigs"] += len(jobs)
         for fsm, request, start, end in spans:
             fsm_ok, error = True, None
+            if isinstance(request, VerifySigRequest):
+                if not all(ok[start:end]):
+                    fsm_ok = False
+                    error = SignatureError(
+                        f"Signature did not match: {request.description}")
+                fsm.deliver_verify_result(fsm_ok, error)
+                continue
             if not all(ok[start:end]):
                 fsm_ok = False
                 bad = [

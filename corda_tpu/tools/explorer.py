@@ -125,18 +125,22 @@ class ExplorerModel:
             if txhash is None or txhash in seen:
                 continue
             seen.add(txhash)
-            if txhash not in self._tx_cache:
-                self._tx_cache[txhash] = rpc.call(
-                    "verified_transaction", txhash)
-            stx = self._tx_cache[txhash]
+            stx = self._tx_cache.get(txhash)
+            if stx is None:  # never cache a miss: the tx may land later
+                stx = rpc.call("verified_transaction", txhash)
+                if stx is not None:
+                    self._tx_cache[txhash] = stx
             if stx is not None:
                 transactions.append(stx)
             if len(transactions) >= self.MAX_TX:
                 break
-        # Bound the cache to hashes still referenced by the vault.
+        # Bound the cache to hashes still referenced by the vault (the full
+        # snapshot, not just the prefix visited before the MAX_TX break).
         if len(self._tx_cache) > 4 * self.MAX_TX:
+            live = {getattr(getattr(s, "ref", None), "txhash", None)
+                    for s in vault}
             self._tx_cache = {h: s for h, s in self._tx_cache.items()
-                              if h in seen}
+                              if h in live}
 
         return {
             "identity": render_value(identity),

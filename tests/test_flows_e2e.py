@@ -157,12 +157,44 @@ class TestFinality:
         net.run_network()
         for h in handles:
             h.result.result()
-        # Deferred flushing batches all 4 concurrent client-side checks into
-        # ONE kernel call; same on the notary side.
-        assert alice.smm.metrics["verify_sigs"] >= 4
-        assert alice.smm.metrics["verify_batches"] == 1
+        # Deferred flushing batches the 4 concurrent flows' checks into ONE
+        # kernel call per phase: the clients' own-signature round and their
+        # notary-response-signature round (2 on alice), and the notary's
+        # request-validation round.
+        assert alice.smm.metrics["verify_sigs"] >= 8  # 4 tx checks + 4 result sigs
+        assert alice.smm.metrics["verify_batches"] == 2
         assert notary.smm.metrics["verify_sigs"] >= 4
         assert notary.smm.metrics["verify_batches"] <= 2
+
+
+class TestSingleSigPump:
+    def test_bad_signature_rejected_via_pump(self, net):
+        """verify_signature_batched delivers SignatureError for a corrupted
+        signature (the notary-response validation path)."""
+        from corda_tpu.crypto.keys import SignatureError
+        from corda_tpu.flows.api import FlowLogic, register_flow
+
+        _, alice, _ = make_parties(net)
+        content = b"notary-signed-content-0123456789ab"
+        good = alice.key.sign(content)
+        bad = type(good)(good.bytes[:5] + bytes([good.bytes[5] ^ 1])
+                         + good.bytes[6:], good.by)
+
+        @register_flow
+        class CheckSigFlow(FlowLogic):
+            def __init__(self, sig):
+                self.sig = sig
+
+            def call(self):
+                yield self.verify_signature_batched(self.sig, content)
+                return "ok"
+
+        h_good = alice.start_flow(CheckSigFlow(good))
+        h_bad = alice.start_flow(CheckSigFlow(bad))
+        net.run_network()
+        assert h_good.result.result() == "ok"
+        with pytest.raises(SignatureError):
+            h_bad.result.result()
 
 
 class TestRecovery:
