@@ -871,5 +871,11 @@ def generate_issue(arrangement: Arrangement, at: PartyAndReference,
                   key=lambda k: k.to_base58_string())
     builder.add_output_state(
         TransactionState(UniversalState(tuple(keys), arrangement), notary))
-    builder.add_command(UIssue(), at.party.owning_key)
+    # Declare every liable party as a command signer (verify demands their
+    # signatures; two-sided products like swaps have several, so declaring
+    # only the issuer — as the reference's generateIssue:311-316 does —
+    # would make the issue unverifiable by counterparties).
+    signers = sorted(liable_parties(arrangement) | {at.party.owning_key},
+                     key=lambda k: k.to_base58_string())
+    builder.add_command(UIssue(), *signers)
     return builder

@@ -131,3 +131,45 @@ class BusinessCalendar:
         for c in calendars:
             out = out | c.holidays
         return BusinessCalendar(out)
+
+
+class Frequency:
+    """Payment schedule frequencies (FinanceTypes.kt:242-263 Frequency):
+    each is a (name, annual compound count, tenor) triple; ``offset`` steps a
+    date forward n periods via the tenor's calendar arithmetic. The seven
+    canonical instances below are the registry; constructing ad-hoc
+    Frequency values is fine but never aliases ``Frequency.of``."""
+
+    _BY_NAME: dict[str, "Frequency"] = {}
+
+    def __init__(self, name: str, annual_compound_count: int, tenor_name: str):
+        self.name = name
+        self.annual_compound_count = annual_compound_count
+        self.tenor = Tenor(tenor_name)
+
+    def offset(self, day: int, n: int = 1) -> int:
+        for _ in range(n):
+            day += self.tenor.days_from(day)
+        return day
+
+    @staticmethod
+    def of(name: str) -> "Frequency":
+        try:
+            return Frequency._BY_NAME[name]
+        except KeyError:
+            raise ValueError(f"unknown frequency {name!r}") from None
+
+    def __repr__(self):
+        return f"Frequency.{self.name}"
+
+
+for _freq in (Frequency("Annual", 1, "1Y"), Frequency("SemiAnnual", 2, "6M"),
+              Frequency("Quarterly", 4, "3M"), Frequency("Monthly", 12, "1M"),
+              Frequency("BiWeekly", 26, "2W"), Frequency("Weekly", 52, "1W"),
+              Frequency("Daily", 365, "1D")):
+    Frequency._BY_NAME[_freq.name] = _freq
+    setattr(Frequency, {"Annual": "ANNUAL", "SemiAnnual": "SEMI_ANNUAL",
+                        "Quarterly": "QUARTERLY", "Monthly": "MONTHLY",
+                        "BiWeekly": "BI_WEEKLY", "Weekly": "WEEKLY",
+                        "Daily": "DAILY"}[_freq.name], _freq)
+del _freq

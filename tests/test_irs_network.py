@@ -1,0 +1,188 @@
+"""The universal IRS driven over the network: oracle fixing with tear-off
+signature, netted settlement, notarisation, broadcast — per period.
+
+Mirrors the reference's irs-demo flow composition (reference:
+samples/irs-demo/.../flows/ — RatesFixFlow + FixingFlow through
+NodeInterestRates.Oracle and the notary) with the product expressed on the
+universal-contract DSL (experimental/.../universal/IRS.kt) instead of a
+bespoke contract.
+"""
+
+import datetime as dt
+
+import pytest
+
+from corda_tpu.contracts.structures import StateRef
+from corda_tpu.contracts.universal import (
+    SCALE,
+    RollOut,
+    Transfer,
+    eval_amount,
+    generate_issue,
+)
+from corda_tpu.finance.irs import IrsFixFlow, IrsSettleFlow, interest_rate_swap
+from corda_tpu.finance.types import Tenor, date_to_days
+from corda_tpu.flows.api import FlowException
+from corda_tpu.flows.finality import FinalityFlow
+from corda_tpu.flows.notary import NotaryException
+from corda_tpu.flows.oracle import FixOf, RateOracle
+from corda_tpu.testing.mock_network import MockNetwork
+
+START = date_to_days(dt.date(2016, 9, 1))
+END = date_to_days(dt.date(2018, 9, 1))
+LIBOR_AT_START = FixOf("LIBOR", START, "3M")
+RATE = SCALE  # 1.0%
+
+
+@pytest.fixture()
+def net():
+    network = MockNetwork()
+    yield network
+
+
+def build_network(network):
+    notary = network.create_notary_node("Notary", validating=False)
+    acme = network.create_node("ACME")
+    highst = network.create_node("HighSt")
+    oracle_node = network.create_node("Oracle")
+    RateOracle(oracle_node.smm, oracle_node.key, {LIBOR_AT_START: RATE})
+    swap = interest_rate_swap(
+        notional=50_000_000 * SCALE, currency="EUR",
+        fixed_rate=SCALE // 2, floating_index="LIBOR", index_tenor="3M",
+        oracle=oracle_node.identity, fixed_leg_payer=acme.identity,
+        floating_leg_payer=highst.identity, start_day=START, end_day=END,
+        frequency=Tenor("3M"))
+    builder = generate_issue(swap, highst.identity.ref(b"\x01"),
+                             notary.identity)
+    builder.sign_with(highst.key)
+    builder.sign_with(acme.key)  # both legs are liable -> both sign issue
+    issue_stx = builder.to_signed_transaction()
+    h = highst.start_flow(FinalityFlow(
+        issue_stx, (highst.identity, acme.identity)))
+    network.run_network()
+    h.result.result()
+    return notary, acme, highst, oracle_node, issue_stx
+
+
+def test_full_period_over_network(net):
+    notary, acme, highst, oracle_node, issue_stx = build_network(net)
+    # both vaults hold the swap
+    for node in (acme, highst):
+        assert any(
+            isinstance(s.state.data.details, RollOut)
+            for s in node.services.vault_service.current_vault.states)
+
+    # -- fix the period via the oracle (tear-off signature)
+    h = highst.start_flow(IrsFixFlow(
+        StateRef(issue_stx.id, 0), oracle_node.identity, acme.identity))
+    net.run_network()
+    fixed_stx = h.result.result()
+    oracle_keys = oracle_node.identity.owning_key.keys
+    assert any(sig.by in oracle_keys for sig in fixed_stx.sigs), \
+        "oracle's tear-off signature must ride the fixing transaction"
+
+    # -- settle the period: floating 1.0% > fixed 0.5%, HighSt pays ACME
+    h2 = acme.start_flow(IrsSettleFlow(
+        StateRef(fixed_stx.id, 0), highst.identity))
+    net.run_network()
+    settle_stx = h2.result.result()
+    outs = [o.data.details for o in settle_stx.tx.outputs]
+    transfers = [d for d in outs if isinstance(d, Transfer)]
+    rolls = [d for d in outs if isinstance(d, RollOut)]
+    assert len(transfers) == 2 and len(rolls) == 1
+    to_acme = next(t for t in transfers if t.to_party == acme.identity)
+    to_highst = next(t for t in transfers if t.to_party == highst.identity)
+    days = rolls[0].start_day - START
+    assert eval_amount(None, to_acme.amount) == \
+        (50_000_000 * SCALE * (SCALE // 2) * days) // (100 * SCALE * 365)
+    assert eval_amount(None, to_highst.amount) == 0
+    assert rolls[0].end_day == END
+
+    # two commits: the fix consumed the issue output, the settle the fix's
+    assert notary.uniqueness_provider.committed_count == 2
+
+    # -- re-running the identical settle is idempotent (same Merkle id ->
+    # the notary re-issues its signature rather than conflicting)
+    h3 = acme.start_flow(IrsSettleFlow(
+        StateRef(fixed_stx.id, 0), highst.identity))
+    net.run_network()
+    assert h3.result.result().id == settle_stx.id
+
+    # -- but a DIFFERENT transaction consuming the settled input is a
+    # double-spend: notary conflict
+    from corda_tpu.contracts.structures import StateAndRef
+    from corda_tpu.contracts.universal import UAction, UniversalState
+    from corda_tpu.flows.notary import NotaryClientFlow
+    from corda_tpu.transactions.builder import TransactionBuilder
+
+    state = acme.services.load_state(StateRef(fixed_stx.id, 0))
+    rogue = TransactionBuilder(notary=notary.identity)
+    rogue.add_input_state(StateAndRef(state, StateRef(fixed_stx.id, 0)))
+    rogue.add_output_state(UniversalState(
+        state.data.parts, rolls[0]))  # drops the payment legs
+    rogue.add_command(UAction("settle"), acme.identity.owning_key)
+    rogue.sign_with(acme.key)
+    h4 = acme.start_flow(NotaryClientFlow(
+        rogue.to_signed_transaction(check_sufficient_signatures=False)))
+    net.run_network()
+    with pytest.raises(NotaryException):
+        h4.result.result()
+
+
+def test_fix_against_wrong_oracle_refused(net):
+    notary, acme, highst, oracle_node, issue_stx = build_network(net)
+    # ACME is not the pinned oracle: the flow refuses before any tx exists
+    h = highst.start_flow(IrsFixFlow(
+        StateRef(issue_stx.id, 0), acme.identity, acme.identity))
+    net.run_network()
+    with pytest.raises(FlowException, match="different oracle"):
+        h.result.result()
+
+
+def test_settle_before_period_end_fails_cleanly(net):
+    """A period that has not ended yet must refuse to settle with a clean
+    FlowException, not notarise a bogus window."""
+    from corda_tpu.contracts.structures import now_micros
+    from corda_tpu.contracts.universal import generate_issue as gen
+
+    notary = net.create_notary_node("Notary", validating=False)
+    acme = net.create_node("ACME2")
+    highst = net.create_node("HighSt2")
+    oracle_node = net.create_node("Oracle2")
+    today = now_micros() // (86_400 * 1_000_000)
+    fix_of = FixOf("LIBOR", today, "3M")
+    RateOracle(oracle_node.smm, oracle_node.key, {fix_of: RATE})
+    swap = interest_rate_swap(
+        notional=1_000 * SCALE, currency="EUR", fixed_rate=SCALE // 2,
+        floating_index="LIBOR", index_tenor="3M",
+        oracle=oracle_node.identity, fixed_leg_payer=acme.identity,
+        floating_leg_payer=highst.identity, start_day=today,
+        end_day=today + 720, frequency=Tenor("3M"))
+    builder = gen(swap, highst.identity.ref(b"\x02"), notary.identity)
+    builder.sign_with(highst.key)
+    builder.sign_with(acme.key)
+    issue_stx = builder.to_signed_transaction()
+    h = highst.start_flow(FinalityFlow(
+        issue_stx, (highst.identity, acme.identity)))
+    net.run_network()
+    h.result.result()
+
+    h1 = highst.start_flow(IrsFixFlow(
+        StateRef(issue_stx.id, 0), oracle_node.identity, acme.identity))
+    net.run_network()
+    fixed_stx = h1.result.result()
+
+    h2 = acme.start_flow(IrsSettleFlow(
+        StateRef(fixed_stx.id, 0), highst.identity))
+    net.run_network()
+    with pytest.raises(FlowException, match="not ended yet"):
+        h2.result.result()
+
+
+def test_settle_requires_prior_fixing(net):
+    notary, acme, highst, oracle_node, issue_stx = build_network(net)
+    h = acme.start_flow(IrsSettleFlow(
+        StateRef(issue_stx.id, 0), highst.identity))
+    net.run_network()
+    with pytest.raises(FlowException, match="fixing before settling"):
+        h.result.result()

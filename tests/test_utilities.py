@@ -39,6 +39,19 @@ class TestTenorCalendar:
         with pytest.raises(ValueError):
             Tenor("3Q")
 
+    def test_frequency_offsets(self):
+        from corda_tpu.finance.types import Frequency
+
+        start = date_to_days(datetime.date(2016, 9, 1))
+        assert days_to_date(Frequency.QUARTERLY.offset(start)) \
+            == datetime.date(2016, 12, 1)
+        assert days_to_date(Frequency.QUARTERLY.offset(start, n=2)) \
+            == datetime.date(2017, 3, 1)
+        assert days_to_date(Frequency.ANNUAL.offset(start)) \
+            == datetime.date(2017, 9, 1)
+        assert Frequency.of("SemiAnnual").annual_compound_count == 2
+        assert Frequency.MONTHLY.tenor == Tenor("1M")
+
     def test_roll_conventions(self):
         sat = date_to_days(datetime.date(2026, 1, 31))  # Saturday
         cal = BusinessCalendar()
