@@ -30,6 +30,11 @@ if TYPE_CHECKING:
 
 MICROS = 1_000_000
 
+# Default clock-skew allowance for notarised timestamps (TimestampChecker's
+# default). Flows that build time windows anchor their guards to this so
+# "the flow refused" and "the notary refused" stay consistent.
+DEFAULT_TIMESTAMP_TOLERANCE_MICROS = 30 * MICROS
+
 
 def now_micros() -> int:
     return int(_time.time() * MICROS)

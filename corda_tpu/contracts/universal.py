@@ -558,6 +558,21 @@ def reduce_rollout(roll: RollOut,
     return _map_arrangement(roll.template, p_map, a_map, into_rollout=False)
 
 
+def collect_fixings(arrangement: Arrangement) -> dict[FixOf, CompositeKey]:
+    """Every date-resolved Fixing in the tree as FixOf -> pinned oracle key —
+    the discovery side of replace_fixings (flows use it to know what to ask
+    an oracle for)."""
+    found: dict[FixOf, CompositeKey] = {}
+
+    def p_map(p):
+        if isinstance(p, Fixing) and isinstance(p.day, Const):
+            found[FixOf(p.source, p.day.value, p.tenor)] = p.oracle
+        return None
+
+    _map_arrangement(arrangement, p_map, lambda a: None)
+    return found
+
+
 def replace_fixings(arrangement: Arrangement, fixes: dict[FixOf, int],
                     used: set | None = None,
                     oracles: dict | None = None) -> Arrangement:

@@ -35,7 +35,7 @@ from ..contracts.universal import (
     Const,
     Continuation,
     EndDate,
-    Fixing,
+    GTE,
     Interest,
     PosPart,
     RollOut,
@@ -49,15 +49,15 @@ from ..contracts.universal import (
     all_of,
     arrange,
     after,
+    collect_fixings,
     eval_amount,
     fixing,
     involved_parties,
     reduce_rollout,
     replace_fixings,
     transfer,
-    _map_arrangement,
+    _DAY_MICROS,
 )
-from ..contracts.universal import GTE, _DAY_MICROS
 from ..crypto.composite import CompositeKey
 from ..crypto.party import Party
 from ..flows.api import FlowException, FlowLogic, register_flow
@@ -126,23 +126,15 @@ def _load_sar(flow: FlowLogic, ref: StateRef) -> StateAndRef:
     return StateAndRef(state, ref)
 
 
-def _period_fix_of(details: RollOut) -> tuple[FixOf, CompositeKey]:
-    """The (FixOf, pinned oracle key) of the current period's single Fixing.
-    Products with several fixings per period would generalise this walk."""
-    found: list = []
-
-    def p_map(p):
-        if isinstance(p, Fixing) and isinstance(p.day, Const):
-            found.append((FixOf(p.source, p.day.value, p.tenor), p.oracle))
-        return None
-
-    _map_arrangement(reduce_rollout(details), p_map, lambda a: None)
+def _period_fix_of(reduced) -> tuple[FixOf, CompositeKey]:
+    """The (FixOf, pinned oracle key) of the reduced period's single Fixing.
+    Products with several fixings per period would generalise this."""
+    found = collect_fixings(reduced)
     if not found:
         raise FlowException("current period has no fixing to apply")
-    fix_of, oracle = found[0]
-    if any(f != found[0] for f in found):
+    if len(found) > 1:
         raise FlowException("multiple distinct fixings in one period")
-    return fix_of, oracle
+    return next(iter(found.items()))
 
 
 @register_flow
@@ -162,14 +154,15 @@ class IrsFixFlow(FlowLogic):
         details = sar.state.data.details
         if not isinstance(details, RollOut):
             raise FlowException("fixing applies to RollOut states")
-        fix_of, oracle_key = _period_fix_of(details)
+        reduced = reduce_rollout(details)
+        fix_of, oracle_key = _period_fix_of(reduced)
         if oracle_key != self.oracle_party.owning_key:
             raise FlowException(
                 "the product pins a different oracle for this source")
 
         fix = yield from self.sub_flow(
             RatesFixQueryFlow(self.oracle_party, fix_of))
-        fixed = replace_fixings(reduce_rollout(details), {fix.of: fix.value})
+        fixed = replace_fixings(reduced, {fix.of: fix.value})
 
         me = self.service_hub.my_identity
         tx = TransactionBuilder(notary=sar.state.notary)
@@ -179,6 +172,9 @@ class IrsFixFlow(FlowLogic):
         tx.add_command(Command(fix, (self.oracle_party.owning_key,)))
         tx.sign_with(self.service_hub.legal_identity_key)
         ptx = tx.to_signed_transaction(check_sufficient_signatures=False)
+        # Fail fast on OUR node before consuming anyone's time: a transition
+        # the contract rejects must never reach the oracle or the notary.
+        ptx.tx.to_ledger_transaction(self.service_hub).verify()
 
         oracle_sig = yield from self.sub_flow(
             RatesFixSignFlow(self.oracle_party, ptx))
@@ -249,5 +245,10 @@ class IrsSettleFlow(FlowLogic):
         tx.add_command(UAction(self.action_name), me.owning_key)
         tx.sign_with(self.service_hub.legal_identity_key)
         stx = tx.to_signed_transaction(check_sufficient_signatures=False)
+        # Verify locally BEFORE notarising: a condition shape this flow's
+        # window anchoring doesn't cover (composite conditions, computed
+        # days) must fail here — not consume the input at the notary with a
+        # transaction every counterparty will reject.
+        stx.tx.to_ledger_transaction(self.service_hub).verify()
         return (yield from self.sub_flow(
             FinalityFlow(stx, (me, self.counterparty))))

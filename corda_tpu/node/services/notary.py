@@ -12,6 +12,7 @@ in-flight notarisation flows survive node restarts.
 
 from __future__ import annotations
 
+from ...contracts.structures import DEFAULT_TIMESTAMP_TOLERANCE_MICROS
 from ...crypto.keys import DigitalSignature, KeyPair
 from ...crypto.party import Party
 from ...flows.notary import NotaryServiceFlow, ValidatingNotaryFlow
@@ -25,7 +26,10 @@ class TimestampChecker:
     """Validity window check for transaction timestamps (reference:
     core/.../node/services/TimestampChecker.kt:12-26)."""
 
-    def __init__(self, clock: Clock | None = None, tolerance_micros: int = 30_000_000):
+    def __init__(self, clock: Clock | None = None,
+                 tolerance_micros: int | None = None):
+        if tolerance_micros is None:
+            tolerance_micros = DEFAULT_TIMESTAMP_TOLERANCE_MICROS
         self.clock = clock or Clock()
         self.tolerance_micros = tolerance_micros
 

@@ -186,3 +186,55 @@ def test_settle_requires_prior_fixing(net):
     net.run_network()
     with pytest.raises(FlowException, match="fixing before settling"):
         h.result.result()
+
+
+class TestIrsFixKillAtEveryStep:
+    """The fixing protocol (oracle query -> tear-off sign -> notarise ->
+    broadcast) completes exactly once no matter where the fixer or the
+    oracle node crashes (the SURVEY §7 hard-part-#3 property applied to the
+    deepest flow composition in the framework)."""
+
+    @pytest.mark.parametrize("crash_after", [1, 2, 3, 4, 5, 6, 7, 8])
+    @pytest.mark.parametrize("victim", ["fixer", "oracle"])
+    def test_crash_at_step(self, crash_after, victim):
+        from corda_tpu.contracts.universal import Actions
+        from corda_tpu.crypto.provider import CpuVerifier
+
+        net = MockNetwork(verifier=CpuVerifier())
+        try:
+            notary, acme, highst, oracle_node, issue_stx = build_network(net)
+            highst.start_flow(IrsFixFlow(
+                StateRef(issue_stx.id, 0), oracle_node.identity,
+                acme.identity))
+            steps, crashed = 0, False
+            while True:
+                progressed = net.messaging_network.pump()
+                if not progressed:
+                    flushed = sum(
+                        n.smm.flush_pending_verifies() for n in net.nodes)
+                    if not flushed:
+                        break
+                steps += 1
+                if steps == crash_after and not crashed:
+                    crashed = True
+                    if victim == "fixer":
+                        highst = highst.restart()
+                    else:
+                        oracle_node = oracle_node.restart()
+                        # A rebooted oracle node re-wires its service at
+                        # startup, exactly as a real node's plugin would.
+                        RateOracle(oracle_node.smm, oracle_node.key,
+                                   {LIBOR_AT_START: RATE})
+            net.run_network()
+            assert notary.uniqueness_provider.committed_count == 1, (
+                f"crash_after={crash_after} victim={victim}: "
+                "fixing did not commit exactly once")
+            for node in (highst, acme):
+                fixed = [s for s in
+                         node.services.vault_service.current_vault.states
+                         if isinstance(s.state.data.details, Actions)]
+                assert len(fixed) == 1, (
+                    f"crash_after={crash_after} victim={victim}: "
+                    f"{node.name} vault lacks the fixed state")
+        finally:
+            net.stop_nodes()
