@@ -46,7 +46,7 @@ def make_corpus(n_distinct: int = N_DISTINCT):
     for i in range(n_distinct):
         sk = bytes([(i % 255) + 1]) * 32
         pk = ref.public_key(sk)
-        m = b"bench-tx-id-%06d" % i
+        m = (b"bench-tx-id-%06d" % i).ljust(32, b".")  # tx ids are 32 bytes
         s = ref.sign(sk, m)
         ok = i % 8 != 7
         if not ok:
@@ -77,7 +77,7 @@ def bench_kernel(pks, msgs, sigs, valid):
 
     from corda_tpu.ops import ed25519_jax
 
-    kernel, e2e = {}, {}
+    kernel, e2e, devhash = {}, {}, {}
     for bucket in BUCKETS:
         bp = tile(pks, bucket)
         bm = tile(msgs, bucket)
@@ -100,7 +100,19 @@ def bench_kernel(pks, msgs, sigs, valid):
 
         run_e2e()
         e2e[bucket] = bucket / _time_median(run_e2e, repeats=3)
-    return kernel, e2e
+
+        def run_devhash():
+            a, _ = ed25519_jax.precompute_batch_device(bp, bm, bs,
+                                                       bucket=bucket)
+            np.asarray(ed25519_jax.verify_arrays_hashed(*a))
+
+        run_devhash()  # compile
+        out = np.asarray(ed25519_jax.verify_arrays_hashed(
+            *ed25519_jax.precompute_batch_device(bp, bm, bs,
+                                                 bucket=bucket)[0]))
+        assert out.tolist() == expect, "device-hash path diverged from oracle"
+        devhash[bucket] = bucket / _time_median(run_devhash, repeats=3)
+    return kernel, e2e, devhash
 
 
 def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5):
@@ -188,12 +200,11 @@ def bench_notary_roundtrip(n_flows=64):
 
         # Warm the verifier's small-bucket executable OUTSIDE the timed
         # region (compile is once-per-process; production nodes warm at boot).
+        # Go through verify_batch itself so the exact pump path — the
+        # device-hash route for 32-byte tx ids — is what gets compiled.
         from corda_tpu.ops import ed25519_jax as _ej
 
-        warm, _ = _ej.precompute_batch(
-            [bytes(32)], [b"warm"], [bytes(64)],
-            bucket=1024 if _ej._pallas_available() else 64)
-        np.asarray(_ej.verify_arrays_auto(*warm))
+        _ej.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
 
         t0 = time.perf_counter()
         done_at = []
@@ -221,23 +232,37 @@ def bench_notary_roundtrip(n_flows=64):
 def main():
     import jax
 
+    # Persistent compilation cache: the kernel zoo (per-bucket Ed25519 +
+    # SHA-512 graphs) compiles once per machine instead of once per run.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/corda_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax: cache knobs absent; just compile
+
     device = str(jax.devices()[0])
     pks, msgs, sigs, valid = make_corpus()
 
-    kernel, e2e = bench_kernel(pks, msgs, sigs, valid)
-    stream = bench_stream(pks, msgs, sigs, valid)
-    sha = bench_sha256()
-    cpu = bench_cpu_oracle(pks, msgs, sigs)
+    # Roundtrip FIRST: it uses small (1024-lane) buckets, and running it
+    # after the 64k-bucket phases was measured to suffer a multi-second
+    # device-allocator stall that has nothing to do with the protocol.
     try:
         notary = bench_notary_roundtrip()
         notary_err = None
     except Exception as e:  # keep the headline number even if e2e tier breaks
         notary, notary_err = None, f"{type(e).__name__}: {e}"
 
+    kernel, e2e, devhash = bench_kernel(pks, msgs, sigs, valid)
+    stream = bench_stream(pks, msgs, sigs, valid)
+    sha = bench_sha256()
+    cpu = bench_cpu_oracle(pks, msgs, sigs)
+
     from corda_tpu.ops.ed25519_jax import _pallas_available
 
-    best_bucket = max(e2e, key=lambda b: e2e[b])
-    headline = max(e2e[best_bucket], stream)
+    best = {**e2e, **{k: max(e2e[k], devhash[k]) for k in devhash}}
+    best_bucket = max(best, key=lambda b: best[b])
+    headline = max(best[best_bucket], stream)
     print(json.dumps({
         "metric": "verified_sigs_per_sec",
         "value": round(headline, 1),
@@ -248,6 +273,8 @@ def main():
         "best_bucket": best_bucket,
         "kernel_sigs_per_sec": {str(k): round(v, 1) for k, v in kernel.items()},
         "e2e_sigs_per_sec": {str(k): round(v, 1) for k, v in e2e.items()},
+        "e2e_devhash_sigs_per_sec": {
+            str(k): round(v, 1) for k, v in devhash.items()},
         "e2e_stream_sigs_per_sec": round(stream, 1),
         "sha256_64B_hashes_per_sec": round(sha, 1),
         "cpu_oracle_sigs_per_sec": round(cpu, 1),

@@ -317,18 +317,8 @@ def precompute_batch(pubkeys, msgs, sigs, bucket: int | None = None):
     """
     n = len(sigs)
     b = bucket or pick_bucket(n)
-    # Bulk byte concatenation + one frombuffer per array: ~10x faster than
-    # per-row numpy assignment at notary batch sizes.
-    pk_cat = b"".join(bytes(k) for k in pubkeys)
-    sig_cat = b"".join(bytes(s) for s in sigs)
-    pk = np.zeros((b, 32), np.uint8)
-    r_enc = np.zeros((b, 32), np.uint8)
-    s_raw = np.zeros((b, 32), np.uint8)
+    pk_cat, sig_cat, pk, r_enc, s_raw = _pack_pk_rs(pubkeys, sigs, n, b)
     h_raw = np.zeros((b, 32), np.uint8)
-    pk[:n] = np.frombuffer(pk_cat, np.uint8).reshape(n, 32)
-    sg = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
-    r_enc[:n] = sg[:, :32]
-    s_raw[:n] = sg[:, 32:]
     # Per-signature SHA-512 + big-int mod L: both are C-speed (hashlib and
     # CPython long division); a fully vectorized numpy mod-L was measured
     # SLOWER at 64k-signature batches, so the simple loop stays.
@@ -342,6 +332,22 @@ def precompute_batch(pubkeys, msgs, sigs, bucket: int | None = None):
         h_rows[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
     return (_words_of(pk), _words_of(r_enc),
             _words_of(s_raw), _words_of(h_raw)), n
+
+
+def _pack_pk_rs(pubkeys, sigs, n: int, b: int):
+    """Shared byte packing: keys + signatures -> padded (b, 32) uint8 arrays
+    for A, R, S. Bulk concatenation + one frombuffer per array: ~10x faster
+    than per-row numpy assignment at notary batch sizes."""
+    pk_cat = b"".join(bytes(k) for k in pubkeys)
+    sig_cat = b"".join(bytes(s) for s in sigs)
+    pk = np.zeros((b, 32), np.uint8)
+    r_enc = np.zeros((b, 32), np.uint8)
+    s_raw = np.zeros((b, 32), np.uint8)
+    pk[:n] = np.frombuffer(pk_cat, np.uint8).reshape(n, 32)
+    sg = np.frombuffer(sig_cat, np.uint8).reshape(n, 64)
+    r_enc[:n] = sg[:, :32]
+    s_raw[:n] = sg[:, 32:]
+    return pk_cat, sig_cat, pk, r_enc, s_raw
 
 
 _PALLAS_STATE = {"available": None}
@@ -394,13 +400,54 @@ def verify_batch(pubkeys, msgs, sigs) -> np.ndarray:
     bucket = pick_bucket(len(good))
     if _pallas_available():
         bucket = max(bucket, 1024)  # Pallas blocks are 1024 lanes
-    arrays, _ = precompute_batch([pubkeys[i] for i in good],
-                                 [msgs[i] for i in good],
-                                 [sigs[i] for i in good], bucket=bucket)
-    out = np.asarray(verify_arrays_auto(*arrays))
+    gp = [pubkeys[i] for i in good]
+    gm = [msgs[i] for i in good]
+    gs = [sigs[i] for i in good]
+    verify_fn, arrays, _ = _precompute_auto(gp, gm, gs, bucket)
+    out = np.asarray(verify_fn(*arrays))
     for j, i in enumerate(good):
         ok_shape[i] = out[j]
     return ok_shape
+
+
+def precompute_batch_device(pubkeys, msgs, sigs, bucket: int | None = None):
+    """Host packing for the fully-on-device path: NO host hashing. All
+    messages must be exactly 32 bytes (the notary workload: tx ids). Returns
+    ((a_words, r_words, s_words, m_words), n) for verify_arrays_hashed —
+    the per-signature SHA-512 + mod-L loop of precompute_batch becomes a
+    batched device graph (ops/sha512_jax.py)."""
+    n = len(sigs)
+    b = bucket or pick_bucket(n)
+    m_cat = b"".join(bytes(m) for m in msgs)
+    if len(m_cat) != 32 * n:
+        raise ValueError("device-hash path requires 32-byte messages")
+    _, _, pk, r_enc, s_raw = _pack_pk_rs(pubkeys, sigs, n, b)
+    m_raw = np.zeros((b, 32), np.uint8)
+    m_raw[:n] = np.frombuffer(m_cat, np.uint8).reshape(n, 32)
+    return (_words_of(pk), _words_of(r_enc),
+            _words_of(s_raw), _words_of(m_raw)), n
+
+
+def verify_arrays_hashed(a_words, r_words, s_words, m_words):
+    """End-to-end device verification for 32-byte messages: the challenge
+    h = SHA-512(R||A||M) mod L is computed on device, then fed to the best
+    available verify backend (Pallas on TPU, XLA otherwise)."""
+    from . import sha512_jax
+
+    h_words = sha512_jax.challenge_words(r_words, a_words, m_words)
+    return verify_arrays_auto(a_words, r_words, s_words, h_words)
+
+
+def _precompute_auto(pubkeys, msgs, sigs, bucket: int | None):
+    """The one dispatch policy for host- vs device-hashed verification:
+    all-32-byte messages (tx ids) go fully on device. Returns
+    (verify_fn, arrays, n)."""
+    if all(len(bytes(m)) == 32 for m in msgs):
+        arrays, n = precompute_batch_device(pubkeys, msgs, sigs,
+                                            bucket=bucket)
+        return verify_arrays_hashed, arrays, n
+    arrays, n = precompute_batch(pubkeys, msgs, sigs, bucket=bucket)
+    return verify_arrays_auto, arrays, n
 
 
 def verify_stream(batches, bucket: int | None = None):
@@ -418,8 +465,8 @@ def verify_stream(batches, bucket: int | None = None):
 
     pending = None  # (device_out, n) for the batch already dispatched
     for pubkeys, msgs, sigs in batches:
-        arrays, n = precompute_batch(pubkeys, msgs, sigs, bucket=bucket)
-        out = verify_arrays_auto(*jax.device_put(arrays))
+        verify_fn, arrays, n = _precompute_auto(pubkeys, msgs, sigs, bucket)
+        out = verify_fn(*jax.device_put(arrays))
         if pending is not None:
             prev_out, prev_n = pending
             yield np.asarray(prev_out)[:prev_n]

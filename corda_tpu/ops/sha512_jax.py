@@ -1,0 +1,358 @@
+"""Batched single-block SHA-512 + mod-L scalar reduction on device.
+
+This moves the last host-side stage of Ed25519 verification onto the TPU.
+The challenge scalar h = SHA-512(R || A || M) mod L was computed per
+signature in Python (``ed25519_jax.precompute_batch``) — at 64k-signature
+buckets that loop is as expensive as the whole device kernel. For the
+notary workload the message is always a 32-byte transaction id (reference:
+core/.../transactions/SignedTransaction.kt:83-87 signs/verifies over
+``stx.id.bytes``; id is the Merkle root, WireTransaction.kt:45-52), so
+R||A||M is a fixed 96 bytes — exactly one padded SHA-512 block — and both
+the hash and the reduction become fixed-shape batched graphs.
+
+Representation: TPUs have no 64-bit lanes (and JAX runs x64-disabled), so a
+64-bit SHA-512 word is an (hi, lo) pair of uint32 arrays, batch minor —
+the same layout discipline as fe25519/sha256_jax. The scalar reduction uses
+43 limbs of 12 bits in int32 (252 = 21*12, so the split at 2^252 is
+limb-aligned) with the identity 2^252 ≡ -delta (mod L), L = 2^252 + delta.
+
+Byte-identical to hashlib.sha512 + python int % L — golden tests enforce it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sha512_96_words", "sc_reduce_words", "challenge_words"]
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+_K512 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f,
+    0xe9b5dba58189dbbc, 0x3956c25bf348b538, 0x59f111f1b605d019,
+    0x923f82a4af194f9b, 0xab1c5ed5da6d8118, 0xd807aa98a3030242,
+    0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235,
+    0xc19bf174cf692694, 0xe49b69c19ef14ad2, 0xefbe4786384f25e3,
+    0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65, 0x2de92c6f592b0275,
+    0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f,
+    0xbf597fc7beef0ee4, 0xc6e00bf33da88fc2, 0xd5a79147930aa725,
+    0x06ca6351e003826f, 0x142929670a0e6e70, 0x27b70a8546d22ffc,
+    0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6,
+    0x92722c851482353b, 0xa2bfe8a14cf10364, 0xa81a664bbc423001,
+    0xc24b8b70d0f89791, 0xc76c51a30654be30, 0xd192e819d6ef5218,
+    0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99,
+    0x34b0bcb5e19b48a8, 0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb,
+    0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3, 0x748f82ee5defb2fc,
+    0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915,
+    0xc67178f2e372532b, 0xca273eceea26619c, 0xd186b8c721c0c207,
+    0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178, 0x06f067aa72176fba,
+    0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc,
+    0x431d67c49c100d4c, 0x4cc5d4becb3e42b6, 0x597f299cfc657e2a,
+    0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+_K_HI = np.array([k >> 32 for k in _K512], np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K512], np.uint32)
+
+_H0_512 = [
+    0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b,
+    0xa54ff53a5f1d36f1, 0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+    0x1f83d9abfb41bd6b, 0x5be0cd19137e2179,
+]
+_H0_HI = np.array([h >> 32 for h in _H0_512], np.uint32)
+_H0_LO = np.array([h & 0xFFFFFFFF for h in _H0_512], np.uint32)
+
+
+# --- 64-bit ops on (hi, lo) uint32 pairs -----------------------------------
+
+
+def _add64(a, b):
+    ahi, alo = a
+    bhi, blo = b
+    lo = alo + blo
+    carry = (lo < alo).astype(U32)
+    return ahi + bhi + carry, lo
+
+
+def _add64_many(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = _add64(out, x)
+    return out
+
+
+def _xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _and64(a, b):
+    return a[0] & b[0], a[1] & b[1]
+
+
+def _not64(a):
+    return ~a[0], ~a[1]
+
+
+def _rotr64(x, n: int):
+    hi, lo = x
+    if n == 32:
+        return lo, hi
+    if n < 32:
+        nh, nl = hi, lo
+    else:
+        nh, nl = lo, hi
+        n -= 32
+    return ((nh >> U32(n)) | (nl << U32(32 - n)),
+            (nl >> U32(n)) | (nh << U32(32 - n)))
+
+
+def _shr64(x, n: int):
+    hi, lo = x
+    if n < 32:
+        return hi >> U32(n), (lo >> U32(n)) | (hi << U32(32 - n))
+    return jnp.zeros_like(hi), hi >> U32(n - 32)
+
+
+def _big_s0(x):
+    return _xor64(_xor64(_rotr64(x, 28), _rotr64(x, 34)), _rotr64(x, 39))
+
+
+def _big_s1(x):
+    return _xor64(_xor64(_rotr64(x, 14), _rotr64(x, 18)), _rotr64(x, 41))
+
+
+def _small_s0(x):
+    return _xor64(_xor64(_rotr64(x, 1), _rotr64(x, 8)), _shr64(x, 7))
+
+
+def _small_s1(x):
+    return _xor64(_xor64(_rotr64(x, 19), _rotr64(x, 61)), _shr64(x, 6))
+
+
+def _compress512(state, block):
+    """One SHA-512 compression. state: (8, N) hi + (8, N) lo; block: 16 words
+    as ((16, N) hi, (16, N) lo). The 80 rounds ride a lax.scan with the
+    16-word message window carried, exactly like sha256_jax._compress."""
+    shi, slo = state
+    bhi, blo = block
+
+    def round_step(carry, k):
+        vars_, whi, wlo = carry
+        a, b, c, d, e, f, g, h = vars_
+        khi, klo = k
+        w = (whi[0], wlo[0])
+        s1 = _big_s1(e)
+        ch = _xor64(_and64(e, f), _and64(_not64(e), g))
+        t1 = _add64_many(h, s1, ch, (khi, klo), w)
+        s0 = _big_s0(a)
+        maj = _xor64(_xor64(_and64(a, b), _and64(a, c)), _and64(b, c))
+        t2 = _add64(s0, maj)
+        neww = _add64_many(_small_s1((whi[14], wlo[14])), (whi[9], wlo[9]),
+                           _small_s0((whi[1], wlo[1])), w)
+        whi = jnp.concatenate([whi[1:], neww[0][None]])
+        wlo = jnp.concatenate([wlo[1:], neww[1][None]])
+        newvars = (_add64(t1, t2), a, b, c, _add64(d, t1), e, f, g)
+        return (newvars, whi, wlo), None
+
+    init_vars = tuple((shi[i], slo[i]) for i in range(8))
+    ks = (jnp.asarray(_K_HI, U32), jnp.asarray(_K_LO, U32))
+    (vars_, _, _), _ = jax.lax.scan(round_step, (init_vars, bhi, blo), ks)
+    out_hi = jnp.stack([_add64((shi[i], slo[i]), vars_[i])[0]
+                        for i in range(8)])
+    out_lo = jnp.stack([_add64((shi[i], slo[i]), vars_[i])[1]
+                        for i in range(8)])
+    return out_hi, out_lo
+
+
+def _bswap32(x):
+    return ((x & U32(0xFF)) << U32(24)) | ((x & U32(0xFF00)) << U32(8)) \
+        | ((x >> U32(8)) & U32(0xFF00)) | (x >> U32(24))
+
+
+def sha512_96_words(r_words, a_words, m_words):
+    """SHA-512(R||A||M) for 32-byte R, A, M given as (8, N) uint32
+    little-endian word arrays. Returns the digest as ((8, N), (8, N)) uint32
+    (hi, lo) pairs of the eight big-endian 64-bit state words."""
+    n = r_words.shape[-1]
+
+    def words64_of(le_words):
+        # bytes are little-endian in le_words; SHA block words are 64-bit
+        # big-endian reads -> hi = bswap(even word), lo = bswap(odd word)
+        return (_bswap32(le_words[0::2]), _bswap32(le_words[1::2]))
+
+    rhi, rlo = words64_of(r_words)
+    ahi, alo = words64_of(a_words)
+    mhi, mlo = words64_of(m_words)
+    zeros = jnp.zeros((1, n), U32)
+    pad_hi = jnp.full((1, n), 0x80000000, U32)  # byte 96 = 0x80
+    len_lo = jnp.full((1, n), 96 * 8, U32)  # 768-bit length, low word
+    bhi = jnp.concatenate([rhi, ahi, mhi, pad_hi, zeros, zeros, zeros])
+    blo = jnp.concatenate([rlo, alo, mlo, zeros, zeros, zeros, len_lo])
+    state = (jnp.broadcast_to(jnp.asarray(_H0_HI, U32)[:, None], (8, n)),
+             jnp.broadcast_to(jnp.asarray(_H0_LO, U32)[:, None], (8, n)))
+    return _compress512(state, (bhi, blo))
+
+
+# --- scalar reduction mod L ------------------------------------------------
+
+SC_RADIX = 12
+SC_MASK = (1 << SC_RADIX) - 1
+SC_NLIMBS = 43  # ceil(512 / 12)
+SC_SPLIT = 21  # 252 = 21 * 12: limbs >= 21 carry the 2^252 overflow
+L = 2**252 + 27742317777372353535851937790883648493
+DELTA = L - 2**252  # 125 bits -> 11 limbs
+_DELTA_LIMBS = [(DELTA >> (SC_RADIX * i)) & SC_MASK for i in range(11)]
+
+
+def _sc_limbs_of_int(x: int, nlimbs: int) -> np.ndarray:
+    return np.array([(x >> (SC_RADIX * i)) & SC_MASK for i in range(nlimbs)],
+                    np.int32)
+
+
+def _sc_carry(limbs, nlimbs: int):
+    """Propagate carries to canonical [0, 2^12) limbs (arithmetic shifts give
+    floor semantics, so intermediate negative limbs are fine as long as the
+    represented value is non-negative). Returns exactly `nlimbs` limbs; the
+    final carry-out must be zero by the caller's bound analysis."""
+    out = []
+    carry = jnp.zeros_like(limbs[0])
+    for i in range(limbs.shape[0]):
+        v = limbs[i] + carry
+        out.append(v & SC_MASK)
+        carry = v >> SC_RADIX  # arithmetic: floor division by 2^12
+    while len(out) < nlimbs:
+        out.append(carry & SC_MASK)
+        carry = carry >> SC_RADIX
+    return jnp.stack(out[:nlimbs])
+
+
+def _sc_mul_delta(hi):
+    """delta * hi for hi of shape (H, N) canonical limbs -> (H+11, N) limb
+    products (each < 2^28: 11 terms of 24-bit products — int32-safe)."""
+    h = hi.shape[0]
+    out = jnp.zeros((h + 11, hi.shape[-1]), I32)
+    for j, d in enumerate(_DELTA_LIMBS):
+        if d:
+            out = out.at[j:j + h].add(hi * I32(d))
+    return out
+
+
+def _sc_fold(limbs, nlimbs_out: int, guard_bits: int):
+    """One folding step: value = lo + 2^252*hi  ≡  lo + (2^guard)*L - delta*hi
+    (mod L), computed non-negatively. Input limbs canonical; output canonical
+    with `nlimbs_out` limbs."""
+    lo, hi = limbs[:SC_SPLIT], limbs[SC_SPLIT:]
+    prod = _sc_mul_delta(hi)
+    width = max(SC_SPLIT, prod.shape[0]) + guard_bits // SC_RADIX + 2
+    guard = _sc_limbs_of_int((1 << guard_bits) * L, width)
+    acc = jnp.broadcast_to(
+        jnp.asarray(guard, I32)[:, None], (width, limbs.shape[-1])
+    ).astype(I32)
+    acc = acc.at[:SC_SPLIT].add(lo)
+    acc = acc.at[:prod.shape[0]].add(-prod)
+    return _sc_carry(acc, nlimbs_out)
+
+
+def sc_reduce_words(digest_hi, digest_lo):
+    """(8, N)+(8, N) uint32 SHA-512 state -> (8, N) uint32 little-endian
+    words of h mod L (the Ed25519 challenge scalar; the digest byte stream is
+    interpreted little-endian, ref10 sc_reduce semantics)."""
+    # 1. The digest byte stream: word i (big-endian 64-bit) contributes
+    # stream bytes 8i..8i+7 = hi>>24, hi>>16, hi>>8, hi, lo>>24, ..., lo.
+    # h is the LITTLE-endian integer of that stream: stream byte j has
+    # weight 2^(8j).
+    byte_rows = []
+    for i in range(8):
+        for w in (digest_hi[i], digest_lo[i]):
+            byte_rows.extend([
+                (w >> U32(24)) & U32(0xFF), (w >> U32(16)) & U32(0xFF),
+                (w >> U32(8)) & U32(0xFF), w & U32(0xFF),
+            ])
+    b = jnp.stack(byte_rows).astype(I32)  # (64, N), stream order
+    # 2. bytes -> 43 limbs of 12 bits (2 limbs per 3 bytes)
+    limbs = []
+    for t in range(SC_NLIMBS):
+        bit = SC_RADIX * t
+        byte, off = bit // 8, bit % 8
+        # a 12-bit limb spans at most 2 bytes (8-off bits of b[byte] plus up
+        # to 12-(8-off) bits of the next byte)
+        v = b[byte] >> I32(off)
+        if byte + 1 < 64:
+            v = v | (b[byte + 1] << I32(8 - off))
+        limbs.append(v & I32(SC_MASK))
+    h = jnp.stack(limbs)  # canonical 43 limbs, < 2^512
+
+    # 3. fold twice, non-negatively, then a signed fold with select:
+    # fold 1: hi = h>>252 < 2^264 (22 limbs), delta*hi < 2^389;
+    #         guard 2^140*L > 2^392 keeps the value positive; out < 2^393.
+    t1 = _sc_fold(h, 34, guard_bits=140)  # 34 limbs = 408 bits headroom
+    # fold 2: hi = t1>>252 < 2^156, delta*hi < 2^281; guard 2^32*L > 2^284.
+    t2 = _sc_fold(t1, 25, guard_bits=32)  # out < 2^285 < 2^300
+    # fold 3: hi = t2>>252 < 2^48, delta*hi < 2^173:
+    #         t3 = lo - delta*hi + 2L  in  (2L - 2^173, 2^252 + 2L) ⊂ (0, 3L)
+    lo3, hi3 = t2[:SC_SPLIT], t2[SC_SPLIT:]
+    prod3 = _sc_mul_delta(hi3)
+    width3 = SC_SPLIT + 2  # 23 limbs = 276 bits
+    acc = jnp.broadcast_to(
+        jnp.asarray(_sc_limbs_of_int(2 * L, width3), I32)[:, None],
+        (width3, t2.shape[-1])).astype(I32)
+    acc = acc.at[:SC_SPLIT].add(lo3)
+    acc = acc.at[:prod3.shape[0]].add(-prod3)
+    out = _sc_carry(acc, width3)
+
+    # 4. canonicalise from [0, 3L): conditionally subtract L twice. The
+    # unselected lanes' subtraction results are garbage (negative totals) —
+    # jnp.where keeps only lanes where out >= L, for which the carry
+    # analysis holds.
+    l_limbs = jnp.asarray(_sc_limbs_of_int(L, width3), I32)[:, None]
+    for _ in range(2):
+        ge = _sc_ge(out, l_limbs)
+        out = jnp.where(ge[None, :], _sc_carry(out - l_limbs, width3), out)
+    # 5. limbs (canonical 12-bit, < L < 2^253) -> (8, N) uint32 LE words
+    return _limbs_to_words(out)
+
+
+def _sc_ge(a, l_limbs):
+    """Lexicographic >= comparison of canonical limb arrays (a: (W, N),
+    l_limbs: (W, 1)) from the most significant limb down."""
+    gt = jnp.zeros(a.shape[-1], bool)
+    eq = jnp.ones(a.shape[-1], bool)
+    for i in range(a.shape[0] - 1, -1, -1):
+        gt = gt | (eq & (a[i] > l_limbs[i]))
+        eq = eq & (a[i] == l_limbs[i])
+    return gt | eq
+
+
+def _limbs_to_words(limbs):
+    """(>=22, N) canonical 12-bit limbs -> (8, N) uint32 LE words."""
+    l = limbs.astype(U32)
+    words = []
+    for w in range(8):
+        bit = 32 * w
+        t, off = bit // SC_RADIX, bit % SC_RADIX
+        v = l[t] >> U32(off)
+        used = SC_RADIX - off
+        while used < 32:
+            t += 1
+            if t < l.shape[0]:
+                v = v | (l[t] << U32(used))
+            used += SC_RADIX
+        words.append(v & U32(0xFFFFFFFF))
+    return jnp.stack(words)
+
+
+@jax.jit
+def challenge_words(r_words, a_words, m_words):
+    """h = SHA-512(R||A||M) mod L fully on device, for 32-byte messages:
+    (8, N) uint32 LE words in, (8, N) uint32 LE words of the scalar out."""
+    hi, lo = sha512_96_words(r_words, a_words, m_words)
+    return sc_reduce_words(hi, lo)

@@ -207,3 +207,59 @@ def test_verify_stream_matches_oracle_across_batches():
 
     outs = list(ed25519_jax.verify_stream(iter(batches), bucket=16))
     assert [o.tolist() for o in outs] == expects
+
+
+def test_device_hash_path_matches_oracle_for_txid_messages():
+    """32-byte messages (tx ids) route through the fully-on-device path
+    (SHA-512 challenge + sc_reduce on device, ops/sha512_jax.py). The accept
+    set must be bit-identical to the oracle, including malformed keys,
+    corrupted signatures, S-malleability and non-canonical encodings."""
+    cases = []
+    for i in range(6):
+        seed, pk = _keypair(100 + i)
+        msg = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        sig = ref.sign(seed, msg)
+        cases.append((pk, msg, sig))
+    seed, pk = _keypair(200)
+    msg = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    sig = ref.sign(seed, msg)
+    s2 = int.from_bytes(sig[32:], "little") + ref.L
+    cases += [
+        (pk, msg, _flip(sig, 1)),             # R corrupted
+        (pk, msg, _flip(sig, 45)),            # S corrupted
+        (_flip(pk, 7), msg, sig),             # pubkey corrupted
+        (pk, bytes(32), sig),                 # wrong message
+        (pk, msg, sig[:32] + s2.to_bytes(32, "little")),  # S+L malleable
+    ]
+    pt = _small_y_point()
+    noncanon = int.to_bytes(
+        int.from_bytes(ref.compress(pt), "little") + ref.P, 32, "little")
+    cases += [(noncanon, bytes(32), bytes(64))]
+
+    # Confirm the device-hash path is what actually runs: the host-hashing
+    # packer must NOT be called for all-32-byte batches.
+    import unittest.mock as mock
+
+    with mock.patch.object(
+            kernel, "precompute_batch",
+            side_effect=AssertionError("host hash path used")) as _:
+        want = _run(cases)
+    assert any(want) and not all(want)
+
+
+def test_device_and_host_hash_paths_agree():
+    pks, msgs, sigs = [], [], []
+    for i in range(32):
+        seed, pk = _keypair(300 + i)
+        m = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        s = ref.sign(seed, m)
+        if i % 5 == 4:
+            s = _flip(s, i % 64)
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(s)
+    host_arrays, _ = kernel.precompute_batch(pks, msgs, sigs, bucket=32)
+    dev_arrays, _ = kernel.precompute_batch_device(pks, msgs, sigs, bucket=32)
+    host = np.asarray(kernel.verify_arrays_auto(*host_arrays))
+    dev = np.asarray(kernel.verify_arrays_hashed(*dev_arrays))
+    assert host.tolist() == dev.tolist()

@@ -1,0 +1,87 @@
+"""Golden vectors for the on-device SHA-512 challenge + sc_reduce kernel."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from corda_tpu.ops import sha512_jax
+from corda_tpu.ops.sha512_jax import L
+
+
+def le_words(data: bytes) -> np.ndarray:
+    """(N*32,) byte chunks -> (8, N) uint32 LE word array for one 32-byte
+    value per column."""
+    arr = np.frombuffer(data, np.uint8).reshape(-1, 32)
+    return np.ascontiguousarray(arr).view("<u4").T.copy()
+
+
+def make_inputs(n, seed=7):
+    rng = np.random.default_rng(seed)
+    r = rng.integers(0, 256, (n, 32), np.uint8).tobytes()
+    a = rng.integers(0, 256, (n, 32), np.uint8).tobytes()
+    m = rng.integers(0, 256, (n, 32), np.uint8).tobytes()
+    return r, a, m
+
+
+def test_sha512_96_matches_hashlib():
+    n = 17
+    r, a, m = make_inputs(n)
+    hi, lo = sha512_jax.sha512_96_words(le_words(r), le_words(a), le_words(m))
+    hi, lo = np.asarray(hi), np.asarray(lo)
+    for i in range(n):
+        want = hashlib.sha512(
+            r[32 * i:32 * i + 32] + a[32 * i:32 * i + 32]
+            + m[32 * i:32 * i + 32]).digest()
+        got = b"".join(
+            int(hi[w, i]).to_bytes(4, "big") + int(lo[w, i]).to_bytes(4, "big")
+            for w in range(8))
+        assert got == want, f"digest {i} diverged"
+
+
+def _reduce_via_kernel(digests: list[bytes]) -> list[int]:
+    hi = np.zeros((8, len(digests)), np.uint32)
+    lo = np.zeros((8, len(digests)), np.uint32)
+    for i, d in enumerate(digests):
+        for w in range(8):
+            word = int.from_bytes(d[8 * w:8 * w + 8], "big")
+            hi[w, i] = word >> 32
+            lo[w, i] = word & 0xFFFFFFFF
+    words = np.asarray(sha512_jax.sc_reduce_words(hi, lo))
+    out = []
+    for i in range(len(digests)):
+        out.append(sum(int(words[w, i]) << (32 * w) for w in range(8)))
+    return out
+
+
+def test_sc_reduce_random():
+    rng = np.random.default_rng(11)
+    digests = [rng.integers(0, 256, 64, np.uint8).tobytes() for _ in range(64)]
+    got = _reduce_via_kernel(digests)
+    for d, g in zip(digests, got):
+        assert g == int.from_bytes(d, "little") % L
+
+
+def test_sc_reduce_edge_values():
+    edges = [0, 1, L - 1, L, L + 1, 2 * L, 3 * L - 1, 2**252, 2**252 - 1,
+             2**255 - 19, 2**256 - 1, 2**511, 2**512 - 1,
+             (2**512 - 1) // L * L,  # largest multiple of L
+             L * (2**259) + L - 1]
+    digests = [e.to_bytes(64, "little") for e in edges]
+    got = _reduce_via_kernel(digests)
+    for e, g in zip(edges, got):
+        assert g == e % L, f"edge {e:#x}: got {g:#x}"
+
+
+def test_challenge_words_end_to_end():
+    n = 9
+    r, a, m = make_inputs(n, seed=23)
+    words = np.asarray(sha512_jax.challenge_words(
+        le_words(r), le_words(a), le_words(m)))
+    for i in range(n):
+        digest = hashlib.sha512(
+            r[32 * i:32 * i + 32] + a[32 * i:32 * i + 32]
+            + m[32 * i:32 * i + 32]).digest()
+        want = int.from_bytes(digest, "little") % L
+        got = sum(int(words[w, i]) << (32 * w) for w in range(8))
+        assert got == want, f"challenge {i} diverged"
