@@ -438,11 +438,16 @@ def verify_arrays_hashed(a_words, r_words, s_words, m_words):
     return verify_arrays_auto(a_words, r_words, s_words, h_words)
 
 
+def device_hash_eligible(msgs) -> bool:
+    """The ONE dispatch predicate for host- vs device-hashed verification
+    (shared by the single-chip and sharded tiers): all-32-byte messages
+    (tx ids) hash on device."""
+    return all(len(bytes(m)) == 32 for m in msgs)
+
+
 def _precompute_auto(pubkeys, msgs, sigs, bucket: int | None):
-    """The one dispatch policy for host- vs device-hashed verification:
-    all-32-byte messages (tx ids) go fully on device. Returns
-    (verify_fn, arrays, n)."""
-    if all(len(bytes(m)) == 32 for m in msgs):
+    """Dispatch per device_hash_eligible. Returns (verify_fn, arrays, n)."""
+    if device_hash_eligible(msgs):
         arrays, n = precompute_batch_device(pubkeys, msgs, sigs,
                                             bucket=bucket)
         return verify_arrays_hashed, arrays, n

@@ -208,7 +208,8 @@ SC_RADIX = 12
 SC_MASK = (1 << SC_RADIX) - 1
 SC_NLIMBS = 43  # ceil(512 / 12)
 SC_SPLIT = 21  # 252 = 21 * 12: limbs >= 21 carry the 2^252 overflow
-L = 2**252 + 27742317777372353535851937790883648493
+from ..crypto.ref_ed25519 import L  # noqa: E402  (single source of truth)
+
 DELTA = L - 2**252  # 125 bits -> 11 limbs
 _DELTA_LIMBS = [(DELTA >> (SC_RADIX * i)) & SC_MASK for i in range(11)]
 

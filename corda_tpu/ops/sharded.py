@@ -26,7 +26,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import ed25519_jax, fe25519 as fe
 
-__all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded", "pad_to_devices"]
+__all__ = ["make_mesh", "sharded_verify_fn", "sharded_verify_hashed_fn",
+           "verify_batch_sharded", "pad_to_devices"]
 
 BATCH_AXIS = "sigs"
 
@@ -56,7 +57,24 @@ _IN_SPECS = (P(None, BATCH_AXIS),) * 4
 _OUT_SPEC = P(BATCH_AXIS)
 
 
-_FN_CACHE: dict[Mesh, object] = {}
+_FN_CACHE: dict[tuple, object] = {}
+
+
+def _sharded_fn(graph_fn, mesh: Mesh):
+    """shard_map + jit a per-lane verify graph over ``mesh``, cached per
+    (graph, mesh). check_vma=False: the scan carry seeds from
+    device-invariant curve constants which the VMA checker would otherwise
+    force us to pcast; the kernels are per-lane independent so replication
+    analysis adds nothing here."""
+    key = (graph_fn, mesh)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        inner = jax.shard_map(
+            graph_fn, mesh=mesh, in_specs=_IN_SPECS, out_specs=_OUT_SPEC,
+            check_vma=False,
+        )
+        fn = _FN_CACHE[key] = jax.jit(inner)
+    return fn
 
 
 def sharded_verify_fn(mesh: Mesh):
@@ -65,29 +83,34 @@ def sharded_verify_fn(mesh: Mesh):
 
     The batch size must be a multiple of the mesh size (use
     :func:`pad_to_devices`; padded lanes simply verify to False).
-    Compiled executables are cached per mesh.
     """
-    fn = _FN_CACHE.get(mesh)
-    if fn is None:
-        # check_vma=False: the scan carry seeds from device-invariant curve
-        # constants which the VMA checker would otherwise force us to pcast;
-        # the kernel is per-lane independent so replication analysis adds
-        # nothing here.
-        inner = jax.shard_map(
-            ed25519_jax.verify_arrays.__wrapped__,  # undecorated graph fn
-            mesh=mesh, in_specs=_IN_SPECS, out_specs=_OUT_SPEC,
-            check_vma=False,
-        )
-        fn = _FN_CACHE[mesh] = jax.jit(inner)
-    return fn
+    return _sharded_fn(ed25519_jax.verify_arrays.__wrapped__, mesh)
+
+
+def _verify_hashed_graph(a_words, r_words, s_words, m_words):
+    """Undecorated fully-on-device graph: SHA-512 challenge + mod-L + verify.
+    Per-lane independent, so sharding the batch axis needs no collectives —
+    each device hashes and verifies its own slice."""
+    from . import sha512_jax
+
+    hi, lo = sha512_jax.sha512_96_words(r_words, a_words, m_words)
+    h_words = sha512_jax.sc_reduce_words(hi, lo)
+    return ed25519_jax.verify_arrays.__wrapped__(
+        a_words, r_words, s_words, h_words)
+
+
+def sharded_verify_hashed_fn(mesh: Mesh):
+    """SPMD twin of ``ed25519_jax.verify_arrays_hashed``: batch axis sharded
+    over ``mesh``, challenge hashing included on device (32-byte messages)."""
+    return _sharded_fn(_verify_hashed_graph, mesh)
 
 
 def verify_batch_sharded(pubkeys, msgs, sigs, mesh: Mesh) -> np.ndarray:
     """End-to-end sharded verify: bool[len(sigs)], malformed inputs reject.
 
-    Host packing is shared with the single-chip path
-    (``ed25519_jax.precompute_batch``); the bucket is rounded up to a multiple
-    of the mesh size so every device gets an equal slice.
+    Host packing and path dispatch are shared with the single-chip tier:
+    all-32-byte messages (tx ids) hash on device; the bucket is rounded up to
+    a multiple of the mesh size so every device gets an equal slice.
     """
     n = len(sigs)
     ok = np.zeros(n, bool)
@@ -97,10 +120,16 @@ def verify_batch_sharded(pubkeys, msgs, sigs, mesh: Mesh) -> np.ndarray:
         return ok
     ndev = mesh.devices.size
     bucket = pad_to_devices(ed25519_jax.pick_bucket(len(good)), ndev)
-    arrays, _ = ed25519_jax.precompute_batch(
-        [pubkeys[i] for i in good], [msgs[i] for i in good],
-        [sigs[i] for i in good], bucket=bucket)
-    out = np.asarray(sharded_verify_fn(mesh)(*arrays))
+    gp = [pubkeys[i] for i in good]
+    gm = [msgs[i] for i in good]
+    gs = [sigs[i] for i in good]
+    if ed25519_jax.device_hash_eligible(gm):
+        arrays, _ = ed25519_jax.precompute_batch_device(gp, gm, gs,
+                                                        bucket=bucket)
+        out = np.asarray(sharded_verify_hashed_fn(mesh)(*arrays))
+    else:
+        arrays, _ = ed25519_jax.precompute_batch(gp, gm, gs, bucket=bucket)
+        out = np.asarray(sharded_verify_fn(mesh)(*arrays))
     for j, i in enumerate(good):
         ok[i] = out[j]
     return ok

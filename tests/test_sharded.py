@@ -62,3 +62,19 @@ def test_pad_to_devices():
     assert sharded.pad_to_devices(8, 8) == 8
     assert sharded.pad_to_devices(9, 8) == 16
     assert sharded.pad_to_devices(0, 8) == 8
+
+
+def test_sharded_device_hash_path_matches_oracle():
+    """32-byte messages route the fully-on-device graph (SHA-512 challenge +
+    mod-L + verify) through the same mesh; accept set must match the
+    oracle, and must match the host-hash sharded path bit-for-bit."""
+    mesh = sharded.make_mesh(8)
+    pks, msgs, sigs = _sig_fixture(19)
+    msgs32 = [m.ljust(32, b".") for m in msgs]
+    sigs32 = [ref.sign(bytes([(i % 255) + 1]) * 32, msgs32[i])
+              if ref.verify(pks[i], msgs[i], sigs[i]) else sigs[i]
+              for i in range(len(sigs))]
+    got = sharded.verify_batch_sharded(pks, msgs32, sigs32, mesh)
+    want = [ref.verify(p, m, s) for p, m, s in zip(pks, msgs32, sigs32)]
+    assert got.tolist() == want
+    assert any(want) and not all(want)
