@@ -1,0 +1,123 @@
+"""Certificate-signing (network permissioning) tests.
+
+Mirrors the reference's certsigning flow (reference: node/.../utilities/
+certsigning/CertificateSigner.kt buildKeyStore — CSR, slow-poll, install)
+against the in-repo authority server.
+"""
+
+import threading
+
+import pytest
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+from corda_tpu.crypto.certsigning import (
+    CertificateRequestRejected,
+    CertificateSigner,
+    CertificateSigningServer,
+    HttpCertificateSigningService,
+)
+from corda_tpu.crypto.x509 import ensure_dev_ca
+
+
+@pytest.fixture()
+def authority(tmp_path):
+    ca_cert, ca_key = ensure_dev_ca(tmp_path / "shared")
+    server = CertificateSigningServer(ca_cert, ca_key)
+    yield server
+    server.stop()
+
+
+def make_csr(cn="TestNode"):
+    key = ec.generate_private_key(ec.SECP256R1())
+    csr = (x509.CertificateSigningRequestBuilder()
+           .subject_name(x509.Name(
+               [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+           .sign(key, hashes.SHA256()))
+    return key, csr.public_bytes(serialization.Encoding.DER)
+
+
+def test_doorman_approval_workflow(authority):
+    service = HttpCertificateSigningService(authority.url)
+    _, csr_der = make_csr("Alice Corp")
+    request_id = service.submit_request(csr_der)
+
+    # pending: poll returns None; the operator sees the request
+    assert service.retrieve_certificates(request_id) is None
+    assert authority.pending_requests() == {request_id: "Alice Corp"}
+
+    authority.approve(request_id)
+    chain = service.retrieve_certificates(request_id)
+    assert chain is not None and len(chain) == 2
+    leaf, root = chain[0], chain[-1]
+    cn = leaf.subject.get_attributes_for_oid(NameOID.COMMON_NAME)[0].value
+    assert cn == "Alice Corp"
+    # leaf really is signed by the root CA
+    root.public_key().verify(
+        leaf.signature, leaf.tbs_certificate_bytes,
+        ec.ECDSA(leaf.signature_hash_algorithm))
+
+
+def test_rejection_raises(authority):
+    service = HttpCertificateSigningService(authority.url)
+    _, csr_der = make_csr()
+    request_id = service.submit_request(csr_der)
+    authority.reject(request_id)
+    with pytest.raises(CertificateRequestRejected):
+        service.retrieve_certificates(request_id)
+
+
+def test_malformed_csr_rejected_at_submit(authority):
+    service = HttpCertificateSigningService(authority.url)
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        service.submit_request(b"this is not a CSR")
+
+
+def test_certificate_signer_end_to_end(tmp_path, authority):
+    authority.auto_approve = True
+    service = HttpCertificateSigningService(authority.url)
+    signer = CertificateSigner(tmp_path / "node", "Bank of TPU", service,
+                               poll_interval=0.01)
+    paths = signer.build_key_store(timeout=10)
+    for p in paths.values():
+        assert p.exists()
+    leaf = x509.load_pem_x509_certificate(paths["cert"].read_bytes())
+    assert leaf.subject.get_attributes_for_oid(
+        NameOID.COMMON_NAME)[0].value == "Bank of TPU"
+    # key on disk matches the certified public key
+    key = serialization.load_pem_private_key(
+        paths["key"].read_bytes(), password=None)
+    assert key.public_key().public_numbers() \
+        == leaf.public_key().public_numbers()
+    # idempotent: a restart finds the material and submits nothing new
+    before = dict(authority._issued)
+    paths2 = signer.build_key_store(timeout=1)
+    assert paths2 == paths and authority._issued == before
+
+
+def test_slow_doorman_approval_completes(tmp_path, authority):
+    """The signer's poll loop survives an authority that approves late
+    (the reference's 1-minute slow-poll, scaled down)."""
+    service = HttpCertificateSigningService(authority.url)
+    signer = CertificateSigner(tmp_path / "node", "Slow Corp", service,
+                               poll_interval=0.02)
+
+    def approve_soon():
+        import time
+
+        for _ in range(200):
+            pending = authority.pending_requests()
+            if pending:
+                authority.approve(next(iter(pending)))
+                return
+            time.sleep(0.01)
+
+    t = threading.Thread(target=approve_soon)
+    t.start()
+    paths = signer.build_key_store(timeout=10)
+    t.join(timeout=5)
+    assert paths["cert"].exists()
