@@ -90,11 +90,12 @@ def sharded_verify_fn(mesh: Mesh):
 def _verify_hashed_graph(a_words, r_words, s_words, m_words):
     """Undecorated fully-on-device graph: SHA-512 challenge + mod-L + verify.
     Per-lane independent, so sharding the batch axis needs no collectives —
-    each device hashes and verifies its own slice."""
+    each device hashes and verifies its own slice. Reuses the single-chip
+    challenge graph (not a copy) so the tiers cannot drift."""
     from . import sha512_jax
 
-    hi, lo = sha512_jax.sha512_96_words(r_words, a_words, m_words)
-    h_words = sha512_jax.sc_reduce_words(hi, lo)
+    h_words = sha512_jax.challenge_words.__wrapped__(
+        r_words, a_words, m_words)
     return ed25519_jax.verify_arrays.__wrapped__(
         a_words, r_words, s_words, h_words)
 

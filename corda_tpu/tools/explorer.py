@@ -258,28 +258,173 @@ class ExplorerServer:
         self._thread.join(timeout=2)
 
 
+class DemoTraffic:
+    """Random-but-valid cash activity against an in-process node — the
+    reference explorer's simulated-node mode (explorer Main.kt `-S` flag +
+    client/mock EventGenerator): issues and moves drawn from the generator
+    monad keep the dashboard alive without a real network."""
+
+    def __init__(self, node, period: float = 0.7, seed: int = 42):
+        import random
+
+        from ..finance.cash import Cash
+        from ..testing.generators import (
+            ExitEvent, IssueEvent, MoveEvent, cash_event_generator)
+
+        self.node = node
+        self.period = period
+        self._stop = threading.Event()
+        self._rng = random.Random(seed)
+        keys = node.services.key_management_service
+        owners = [node.identity.owning_key] + [
+            keys.fresh_key().public.composite for _ in range(3)]
+
+        def issued() -> int:
+            from ..finance import CashState
+
+            return sum(
+                s.state.data.amount.quantity
+                for s in node.services.vault_service.unconsumed_states(
+                    CashState))
+
+        self._gen = cash_event_generator(owners, issued)
+        self._cash = Cash
+        self._issue_cls = IssueEvent
+        self._move_cls = MoveEvent
+        self._exit_cls = ExitEvent
+        self._nonce = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            try:
+                self._tick()
+            except Exception:
+                pass  # demo traffic is best-effort
+
+    def _tick(self) -> None:
+        from ..finance import CashState
+        from ..transactions.builder import TransactionBuilder
+
+        node = self.node
+        event = self._gen.generate(self._rng)
+        if isinstance(event, self._issue_cls):
+            self._nonce += 1
+            builder = self._cash.generate_issue(
+                event.amount, node.identity.ref(bytes([self._nonce % 256])),
+                event.owner, node.identity, nonce=self._nonce)
+            builder.sign_with(node.key)
+            node.services.record_transactions([builder.to_signed_transaction()])
+        elif isinstance(event, (self._move_cls, self._exit_cls)):
+            states = node.services.vault_service.unconsumed_states(CashState)
+            if not states:
+                return
+            builder = TransactionBuilder(notary=node.identity)
+            if isinstance(event, self._move_cls):
+                signers = self._cash.generate_spend(
+                    builder, event.amount, event.new_owner, states)
+            else:
+                # Exit burns an exact issued token: pick one and clamp.
+                from ..finance import Amount
+
+                token = states[0].state.data.amount.token
+                avail = sum(s.state.data.amount.quantity for s in states
+                            if s.state.data.amount.token == token)
+                qty = min(event.amount.quantity, avail)
+                signers = self._cash.generate_exit(
+                    builder, Amount(qty, token), states)
+            keys = node.services.key_management_service
+            for key in signers:
+                for pub in key.keys:
+                    kp = keys.keys.get(pub)
+                    if kp is not None:
+                        builder.sign_with(kp)
+                        break
+            node.services.record_transactions(
+                [builder.to_signed_transaction(
+                    check_sufficient_signatures=False)])
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def _run_demo(listen: int):
+    """An in-process node + generated traffic + dashboard (Main.kt -S)."""
+    import tempfile
+    from pathlib import Path
+
+    from ..node.config import NodeConfig
+    from ..node.node import Node
+
+    tmp = Path(tempfile.mkdtemp(prefix="corda-tpu-explorer-demo-"))
+    node = Node(NodeConfig(
+        name="DemoBank", base_dir=tmp / "DemoBank",
+        network_map=tmp / "netmap.json",
+        rpc_users=({"username": "demo", "password": "demo",
+                    "permissions": ["ALL"]},))).start()
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            node.run_once(timeout=0.02)
+
+    pumper = threading.Thread(target=pump, daemon=True)
+    pumper.start()
+    traffic = DemoTraffic(node)
+    rpc = RpcClient(node.messaging.my_address, "demo", "demo")
+    server = ExplorerServer(rpc, port=listen)
+
+    def cleanup():
+        import shutil
+
+        traffic.stop()
+        server.stop()
+        rpc.close()
+        stop.set()
+        pumper.join(timeout=2)  # never tear the node down under run_once
+        node.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    return server, cleanup
+
+
 def main(argv=None) -> None:
     from ..node.messaging.tcp import TcpAddress
 
     parser = argparse.ArgumentParser(
         description="Web explorer for a running corda_tpu node")
-    parser.add_argument("node", help="node RPC address, host:port")
-    parser.add_argument("user")
-    parser.add_argument("password")
+    parser.add_argument("node", nargs="?",
+                        help="node RPC address, host:port")
+    parser.add_argument("user", nargs="?")
+    parser.add_argument("password", nargs="?")
     parser.add_argument("--listen", type=int, default=8880,
                         help="dashboard port (default 8880)")
+    parser.add_argument("--demo", action="store_true",
+                        help="spin up an in-process node with generated "
+                             "cash traffic (the reference explorer's "
+                             "simulation mode)")
     args = parser.parse_args(argv)
-    host, _, port = args.node.partition(":")
-    rpc = RpcClient(TcpAddress(host, int(port)), args.user, args.password)
-    server = ExplorerServer(rpc, port=args.listen)
+    if args.demo:
+        server, cleanup = _run_demo(args.listen)
+    elif args.node and args.user and args.password:
+        host, _, port = args.node.partition(":")
+        rpc = RpcClient(TcpAddress(host, int(port)), args.user, args.password)
+        server = ExplorerServer(rpc, port=args.listen)
+
+        def cleanup():
+            server.stop()
+            rpc.close()
+    else:
+        parser.error("either --demo or node/user/password are required")
     print(f"explorer on http://{server.address[0]}:{server.address[1]}/")
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
         pass
     finally:
-        server.stop()
-        rpc.close()
+        cleanup()
 
 
 if __name__ == "__main__":

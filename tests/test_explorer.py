@@ -99,3 +99,31 @@ def test_dashboard_aggregates_node_state(live_node):
 
 def test_cash_balances_ignores_foreign_states():
     assert cash_balances([]) == {}
+
+
+def test_demo_traffic_populates_vault(live_node):
+    """The explorer's simulation mode (reference: explorer Main.kt -S +
+    client/mock EventGenerator): generated issues/moves appear in the vault
+    and therefore on the dashboard."""
+    import time
+
+    from corda_tpu.finance import CashState
+    from corda_tpu.tools.explorer import DemoTraffic
+
+    traffic = DemoTraffic(live_node, period=0.01, seed=7)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            states = live_node.services.vault_service.unconsumed_states(
+                CashState)
+            txs = len(live_node.services.storage_service
+                      .validated_transactions)
+            if states and txs >= 5:
+                break
+            time.sleep(0.05)
+        assert states, "demo traffic never issued cash"
+        assert txs >= 5, "demo traffic stalled"
+        assert cash_balances(
+            live_node.services.vault_service.current_vault.states)
+    finally:
+        traffic.stop()
