@@ -198,13 +198,8 @@ def bench_notary_roundtrip(n_flows=64):
             stxs.append(
                 move.to_signed_transaction(check_sufficient_signatures=False))
 
-        # Warm the verifier's small-bucket executable OUTSIDE the timed
-        # region (compile is once-per-process; production nodes warm at boot).
-        # Go through verify_batch itself so the exact pump path — the
-        # device-hash route for 32-byte tx ids — is what gets compiled.
-        from corda_tpu.ops import ed25519_jax as _ej
-
-        _ej.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
+        # Warm the pump-path executable OUTSIDE the timed region.
+        _warm_verify_kernel()
 
         t0 = time.perf_counter()
         done_at = []
@@ -227,6 +222,173 @@ def bench_notary_roundtrip(n_flows=64):
         }
     finally:
         set_verifier(None)
+
+
+def _warm_verify_kernel():
+    """Compile the pump-path executable (device-hash route for 32-byte tx
+    ids at the small bucket) outside any timed/deadlined region. Production
+    nodes warm at boot the same way."""
+    from corda_tpu.ops import ed25519_jax as _ej
+
+    _ej.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
+
+
+def bench_trades(n_trades=6):
+    """BASELINE config 2 (trader-demo): DvP CommercialPaper-for-cash trades
+    through the validating notary over MockNetwork. Issues happen outside
+    the timed region; each timed trade is the full SellerFlow/BuyerFlow
+    composition (resolution, contract verify, notarise, broadcast)."""
+    from corda_tpu.contracts.structures import Issued, Timestamp, now_micros
+    from corda_tpu.crypto.provider import JaxVerifier, set_verifier
+    from corda_tpu.finance import Amount, Cash
+    from corda_tpu.finance.commercial_paper import CommercialPaper
+    from corda_tpu.finance.trade import BuyerFlow, SellerFlow
+    from corda_tpu.flows.notary import NotaryClientFlow
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    WEEK = 7 * 86_400 * 1_000_000
+    verifier = JaxVerifier()
+    set_verifier(verifier)
+    try:
+        # Warm the kernel FIRST: a cold jit compile mid-issue would stall
+        # past the notary's timestamp tolerance window.
+        _warm_verify_kernel()
+        net = MockNetwork(verifier=verifier)
+        notary = net.create_notary_node("Notary", validating=True)
+        seller = net.create_node("Seller")
+        buyer = net.create_node("Buyer")
+        papers = []
+        for i in range(n_trades):
+            ref = seller.identity.ref(bytes([i + 1]))
+            issue = CommercialPaper.generate_issue(
+                ref, Amount(900, Issued(ref, "USD")),
+                now_micros() + WEEK, notary.identity)
+            issue.set_time(Timestamp.around(now_micros(), 30_000_000))
+            issue.sign_with(seller.key)
+            stx = issue.to_signed_transaction(
+                check_sufficient_signatures=False)
+            h = seller.start_flow(NotaryClientFlow(stx))
+            net.run_network()
+            stx = stx.with_additional_signature(h.result.result())
+            seller.record_transaction(stx)
+            papers.append(stx.tx.out_ref(0))
+            cash = Cash.generate_issue(
+                Amount(800, "USD"), buyer.identity.ref(bytes([i + 1])),
+                buyer.identity.owning_key, notary.identity, nonce=i)
+            cash.sign_with(buyer.key)
+            buyer.record_transaction(cash.to_signed_transaction())
+        buyer.register_initiated_flow(
+            "SellerFlow",
+            lambda party: BuyerFlow(party, Amount(750, "USD"),
+                                    notary.identity))
+        durations = []
+        t0 = time.perf_counter()
+        for paper in papers:
+            t1 = time.perf_counter()
+            h = seller.start_flow(SellerFlow(
+                buyer.identity, paper, Amount(750, "USD")))
+            net.run_network()
+            h.result.result()
+            durations.append(time.perf_counter() - t1)
+        dt = time.perf_counter() - t0
+        return {"trades_per_sec": round(n_trades / dt, 2),
+                "trade_median_ms": round(
+                    1e3 * statistics.median(durations), 1)}
+    finally:
+        set_verifier(None)
+
+
+def bench_multisig(n_distinct=64, tile_to=2048):
+    """BASELINE config 4: 3-of-3 CompositeKey multi-sig fan-out — kernel
+    verify of all constituent signatures plus the host-side composite
+    fulfilment walk per transaction."""
+    from corda_tpu.crypto import ref_ed25519 as ref_mod
+    from corda_tpu.crypto.composite import CompositeKey
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.crypto.provider import JaxVerifier, VerifyJob
+
+    signers = [KeyPair.generate(bytes([0x31 + i]) * 32) for i in range(3)]
+    composite = CompositeKey.Builder().add_keys(
+        *[CompositeKey.leaf(kp.public) for kp in signers]).build(threshold=3)
+    txs = []
+    rng = np.random.default_rng(5)
+    for i in range(n_distinct):
+        msg = rng.integers(0, 256, 32, np.uint8).tobytes()
+        sigs = [kp.sign(msg) for kp in signers]
+        if i % 8 == 7:  # drop a signature: fulfilment must fail
+            sigs = sigs[:2]
+        txs.append((msg, sigs))
+    txs = [txs[i % n_distinct] for i in range(tile_to)]
+
+    verifier = JaxVerifier()
+    jobs = [VerifyJob(sig.by.encoded, msg, sig.bytes)
+            for msg, sigs in txs for sig in sigs]
+    spans = []
+    start = 0
+    for msg, sigs in txs:
+        spans.append((start, start + len(sigs)))
+        start += len(sigs)
+
+    def run():
+        ok = verifier.verify_batch(jobs)
+        fulfilled = 0
+        for (msg, sigs), (lo, hi) in zip(txs, spans):
+            valid = {sigs[k - lo].by for k in range(lo, hi) if ok[k]}
+            if composite.is_fulfilled_by(valid):
+                fulfilled += 1
+        return fulfilled
+
+    fulfilled = run()  # compile + correctness
+    assert fulfilled == sum(1 for m, s in txs if len(s) == 3), fulfilled
+    dt = _time_median(run, repeats=3)
+    return {"sigs_per_sec": round(len(jobs) / dt, 1),
+            "tx_per_sec": round(len(txs) / dt, 1)}
+
+
+def bench_partial_merkle(n_cmds=8, repeats=2000):
+    """BASELINE config 5 (simm-valuation shape): FilteredTransaction
+    tear-off proof verification rate (host-side partial-Merkle walk, the
+    oracle's per-request hot path)."""
+    from corda_tpu.contracts.structures import Command
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.crypto.party import Party
+    from corda_tpu.flows.oracle import Fix, FixOf
+    from corda_tpu.testing.dummies import DummyContract
+    from corda_tpu.transactions.builder import TransactionBuilder
+    from corda_tpu.transactions.filtered import (
+        FilteredTransaction, FilterFuns)
+
+    notary = Party.of("N", KeyPair.generate(b"\x41" * 32).public)
+    party = Party.of("P", KeyPair.generate(b"\x42" * 32).public)
+    builder = DummyContract.generate_initial(party.ref(b"\x01"), 1, notary)
+    for i in range(n_cmds):
+        builder.add_command(Command(Fix(FixOf("LIBOR", 20_000 + i, "3M"),
+                                        42_500 + i),
+                                    (party.owning_key,)))
+    wtx = builder.to_wire_transaction()
+    ftx = FilteredTransaction.build_merkle_transaction(
+        wtx, FilterFuns(filter_commands=lambda c: isinstance(c.value, Fix)))
+    assert ftx.verify(wtx.id)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        ftx.verify(wtx.id)
+    dt = time.perf_counter() - t0
+    return {"proofs_per_sec": round(repeats / dt, 1),
+            "revealed_commands": n_cmds}
+
+
+def bench_raft_cluster(n_tx=64):
+    """BASELINE config 1 (raft-notary-demo): a real 3-node Raft notary
+    cluster over TCP + sqlite, firehosed through NotaryClientFlow with the
+    JAX verifier."""
+    from corda_tpu.tools.loadtest import run_loadtest
+
+    _warm_verify_kernel()  # a cold compile would eat the completion deadline
+    res = run_loadtest(n_tx=n_tx, notary="raft", verifier="jax",
+                       max_seconds=120.0)
+    return {"tx_per_sec": res.tx_per_sec, "p99_ms": res.p99_ms,
+            "committed": res.tx_committed,
+            "sigs_verified": res.sigs_verified}
 
 
 def main():
@@ -252,6 +414,18 @@ def main():
         notary_err = None
     except Exception as e:  # keep the headline number even if e2e tier breaks
         notary, notary_err = None, f"{type(e).__name__}: {e}"
+
+    # Per-BASELINE.json-config measurements (each small and bounded; config
+    # 3 — the 100k synthetic firehose — IS the stream measurement below).
+    configs = {}
+    for name, fn in (("raft_notary_3node", bench_raft_cluster),
+                     ("trader_dvp", bench_trades),
+                     ("composite_3of3", bench_multisig),
+                     ("partial_merkle", bench_partial_merkle)):
+        try:
+            configs[name] = fn()
+        except Exception as e:
+            configs[name] = {"error": f"{type(e).__name__}: {e}"}
 
     kernel, e2e, devhash = bench_kernel(pks, msgs, sigs, valid)
     stream = bench_stream(pks, msgs, sigs, valid)
@@ -280,6 +454,7 @@ def main():
         "cpu_oracle_sigs_per_sec": round(cpu, 1),
         "notary_roundtrip": notary,
         "notary_roundtrip_error": notary_err,
+        "baseline_configs": configs,
     }))
 
 
