@@ -116,9 +116,9 @@ def bench_kernel(pks, msgs, sigs, valid):
 
 
 def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5):
-    """Sustained throughput with the double-buffered pipeline: host packing
-    of batch i+1 overlaps device execution of batch i (the notary-pump
-    steady state)."""
+    """Sustained throughput with the depth-2 stream pipeline: host packing
+    and transfer of the next batches overlap device execution of the
+    current one (the notary-pump steady state)."""
     from corda_tpu.ops import ed25519_jax
 
     bp, bm, bs = tile(pks, bucket), tile(msgs, bucket), tile(sigs, bucket)
@@ -302,7 +302,6 @@ def bench_multisig(n_distinct=64, tile_to=2048):
     """BASELINE config 4: 3-of-3 CompositeKey multi-sig fan-out — kernel
     verify of all constituent signatures plus the host-side composite
     fulfilment walk per transaction."""
-    from corda_tpu.crypto import ref_ed25519 as ref_mod
     from corda_tpu.crypto.composite import CompositeKey
     from corda_tpu.crypto.keys import KeyPair
     from corda_tpu.crypto.provider import JaxVerifier, VerifyJob

@@ -455,27 +455,29 @@ def _precompute_auto(pubkeys, msgs, sigs, bucket: int | None):
     return verify_arrays_auto, arrays, n
 
 
-def verify_stream(batches, bucket: int | None = None):
-    """Double-buffered streaming verify: yields one bool array per input
-    batch, in order.
+def verify_stream(batches, bucket: int | None = None, depth: int = 2):
+    """Pipelined streaming verify: yields one bool array per input batch,
+    in order.
 
-    ``batches`` is an iterable of (pubkeys, msgs, sigs) triples. JAX dispatch
-    is asynchronous, so while batch *i* executes on device the host packs
-    batch *i+1* (SHA-512 challenges + word packing) — the two ~equal-cost
-    stages overlap instead of serialising, which is exactly the shape of a
-    notary pump under sustained load (one batch in flight, next one
-    accumulating). ~1.5-2x the serial end-to-end throughput at large buckets.
+    ``batches`` is an iterable of (pubkeys, msgs, sigs) triples. JAX
+    dispatch is asynchronous, so while up to ``depth`` batches are in
+    flight on the device the host packs the next one — host packing,
+    host->device transfer and kernel execution all overlap, which is
+    exactly the shape of a notary pump under sustained load. ``depth``
+    bounds in-flight device memory (4 word arrays per batch); 2 suffices
+    when transfer is fast, deeper helps when the link is slow.
     """
+    import collections
+
     import jax
 
-    pending = None  # (device_out, n) for the batch already dispatched
+    pending = collections.deque()  # (device_out, n), oldest first
     for pubkeys, msgs, sigs in batches:
         verify_fn, arrays, n = _precompute_auto(pubkeys, msgs, sigs, bucket)
-        out = verify_fn(*jax.device_put(arrays))
-        if pending is not None:
-            prev_out, prev_n = pending
+        pending.append((verify_fn(*jax.device_put(arrays)), n))
+        if len(pending) > depth:
+            prev_out, prev_n = pending.popleft()
             yield np.asarray(prev_out)[:prev_n]
-        pending = (out, n)
-    if pending is not None:
-        prev_out, prev_n = pending
+    while pending:
+        prev_out, prev_n = pending.popleft()
         yield np.asarray(prev_out)[:prev_n]
