@@ -463,9 +463,11 @@ def verify_stream(batches, bucket: int | None = None, depth: int = 2):
     dispatch is asynchronous, so while up to ``depth`` batches are in
     flight on the device the host packs the next one — host packing,
     host->device transfer and kernel execution all overlap, which is
-    exactly the shape of a notary pump under sustained load. ``depth``
-    bounds in-flight device memory (4 word arrays per batch); 2 suffices
-    when transfer is fast, deeper helps when the link is slow.
+    exactly the shape of a notary pump under sustained load. Peak device
+    residency is ``depth + 1`` batches (4 word arrays each): ``depth``
+    already dispatched plus the one being dispatched while the oldest is
+    read back. 2 suffices when transfer is fast; deeper helps when the
+    link is slow.
     """
     import collections
 

@@ -180,10 +180,11 @@ def test_shadow_sampling_detects_kernel_divergence(monkeypatch):
         JaxVerifier(shadow_rate=1.0).verify_batch(jobs)
 
 
-def test_verify_stream_matches_oracle_across_batches():
-    """The double-buffered pipeline must return per-batch results in order,
-    bit-identical to the oracle, including mixed valid/invalid rows and
-    varying batch sizes."""
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_verify_stream_matches_oracle_across_batches(depth):
+    """The stream pipeline must return per-batch results in order at every
+    pipeline depth, bit-identical to the oracle, including mixed
+    valid/invalid rows and varying batch sizes."""
     from corda_tpu.crypto import ref_ed25519 as ref
     from corda_tpu.ops import ed25519_jax
 
@@ -205,7 +206,8 @@ def test_verify_stream_matches_oracle_across_batches():
         batches.append((pks, msgs, sigs))
         expects.append(expect)
 
-    outs = list(ed25519_jax.verify_stream(iter(batches), bucket=16))
+    outs = list(ed25519_jax.verify_stream(iter(batches), bucket=16,
+                                      depth=depth))
     assert [o.tolist() for o in outs] == expects
 
 
