@@ -233,6 +233,74 @@ def _warm_verify_kernel():
     _ej.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
 
 
+def _churn_flows():
+    """Module-level (qualname-stable) flow pair for bench_flow_churn —
+    flow names are registry keys, so they must not be function-local."""
+    from corda_tpu.flows.api import FlowLogic, flow_registry, register_flow
+
+    existing = flow_registry.get("ChurnPing")
+    if existing is not None:
+        return existing, flow_registry.get("ChurnPong")
+
+    @register_flow(name="ChurnPing")
+    class ChurnPing(FlowLogic):
+        def __init__(self, other, payload):
+            self.other = other
+            self.payload = payload
+
+        def call(self):
+            reply = yield self.send_and_receive(self.other, self.payload)
+            return reply.unwrap()
+
+    @register_flow(name="ChurnPong")
+    class ChurnPong(FlowLogic):
+        def __init__(self, other):
+            self.other = other
+
+        def call(self):
+            got = yield self.receive(self.other)
+            yield self.send(self.other, got.unwrap() * 2)
+
+    return ChurnPing, ChurnPong
+
+
+def bench_flow_churn(n_flows=512):
+    """Flow-machinery throughput: request/response flow pairs per second
+    over MockNetwork, checkpointing at every suspension. The reference
+    whitepaper names fiber checkpointing (stack walk + Kryo + DB write per
+    suspend) as the node's main bottleneck
+    (corda-technical-whitepaper.tex:1630-1638); this measures our
+    replay-log checkpoint design on the same shape of workload."""
+    from corda_tpu.testing.mock_network import MockNetwork
+
+    ChurnPing, ChurnPong = _churn_flows()
+    net = MockNetwork()
+    try:
+        a = net.create_node("ChurnA")
+        b = net.create_node("ChurnB")
+        b.smm.register_flow_initiator(
+            "ChurnPing", lambda party: ChurnPong(party))
+        # warm one round (session handshake code paths)
+        h = a.start_flow(ChurnPing(b.identity, 1))
+        net.run_network()
+        assert h.result.result() == 2
+        base = (a.smm.metrics.get("checkpointing_rate", 0)
+                + b.smm.metrics.get("checkpointing_rate", 0))
+        t0 = time.perf_counter()
+        handles = [a.start_flow(ChurnPing(b.identity, i))
+                   for i in range(n_flows)]
+        net.run_network()
+        dt = time.perf_counter() - t0
+        for i, h in enumerate(handles):
+            assert h.result.result() == 2 * i
+        checkpoints = (a.smm.metrics.get("checkpointing_rate", 0)
+                       + b.smm.metrics.get("checkpointing_rate", 0)) - base
+        return {"flow_pairs_per_sec": round(n_flows / dt, 1),
+                "checkpoints_recorded": checkpoints}
+    finally:
+        net.stop_nodes()
+
+
 def bench_trades(n_trades=6):
     """BASELINE config 2 (trader-demo): DvP CommercialPaper-for-cash trades
     through the validating notary over MockNetwork. Issues happen outside
@@ -420,7 +488,8 @@ def main():
     for name, fn in (("raft_notary_3node", bench_raft_cluster),
                      ("trader_dvp", bench_trades),
                      ("composite_3of3", bench_multisig),
-                     ("partial_merkle", bench_partial_merkle)):
+                     ("partial_merkle", bench_partial_merkle),
+                     ("flow_churn", bench_flow_churn)):
         try:
             configs[name] = fn()
         except Exception as e:
