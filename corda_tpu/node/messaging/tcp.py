@@ -421,8 +421,11 @@ class TcpMessaging(MessagingService):
                     if kind != "msg":
                         continue
                     _, topic, session_id, unique_id, shost, sport, data = decoded
-                except (DeserializationError, ValueError, IndexError):
-                    continue  # junk from the wire: drop, never crash
+                except (DeserializationError, ValueError, IndexError,
+                        TypeError, KeyError):
+                    # Junk from the wire — including well-framed frames that
+                    # decode to a non-sequence — drop, never crash.
+                    continue
                 message = Message(
                     topic_session=TopicSession(topic, session_id),
                     data=data,
@@ -430,7 +433,10 @@ class TcpMessaging(MessagingService):
                     sender=TcpAddress(shost, sport),
                 )
                 self._inbound.put((conn, message))
-        except OSError:
+        except (OSError, DeserializationError):
+            # Unreadable socket or unframeable stream (port scanners,
+            # oversized length prefixes): drop the connection, never the
+            # thread — the finally below closes it.
             return
         finally:
             try:

@@ -251,3 +251,52 @@ class TestTlsTransport:
         finally:
             for n in nodes:
                 n.stop()
+
+
+class TestHostileSocket:
+    @pytest.mark.filterwarnings(
+        "error::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_raw_garbage_on_the_wire_does_not_kill_the_node(self, tmp_path):
+        """A port-scanner / hostile client writing raw bytes (bad framing,
+        oversized length prefixes, empty connects) must not take the node
+        down or wedge its accept loop — legitimate traffic keeps flowing."""
+        import socket
+        import struct
+
+        notary = make_node(tmp_path, "Notary", notary="simple")
+        alice = make_node(tmp_path, "Alice")
+        nodes = [notary, alice]
+        try:
+            for n in nodes:
+                n.refresh_netmap()
+            addr = (notary.messaging.my_address.host,
+                    notary.messaging.my_address.port)
+            payloads = [
+                b"",                                   # connect + close
+                b"\x00",                               # short read
+                b"GET / HTTP/1.1\r\n\r\n",             # wrong protocol
+                struct.pack(">I", 0xFFFFFFF0) + b"x",  # absurd length prefix
+                b"\xff" * 4096,                        # framed-looking noise
+            ]
+            # a WELL-FRAMED frame whose payload decodes to a non-sequence
+            from corda_tpu.serialization.codec import serialize
+
+            scalar = bytes(serialize(7).bytes)
+            payloads.append(struct.pack(">I", len(scalar)) + scalar)
+            for payload in payloads:
+                s = socket.create_connection(addr, timeout=2)
+                try:
+                    if payload:
+                        s.sendall(payload)
+                finally:
+                    s.close()
+                for n in nodes:
+                    n.run_once(timeout=0.01)
+            # the node still serves legitimate protocol traffic
+            stx = issue_and_move(alice, notary.identity, magic=77)
+            h = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(nodes, lambda: h.result.done)
+            h.result.result().verify(stx.id.bytes)
+        finally:
+            for n in nodes:
+                n.stop()
