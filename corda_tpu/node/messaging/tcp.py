@@ -364,7 +364,9 @@ class TcpMessaging(MessagingService):
                 if frame is None:
                     raise OSError("peer closed during ack wait")
                 decoded = deserialize(frame)
-                if decoded[0] == "ack":
+                if (isinstance(decoded, tuple) and len(decoded) == 2
+                        and decoded[0] == "ack"
+                        and isinstance(decoded[1], bytes)):
                     self._outbox.ack(decoded[1])
                     sent.discard(decoded[1])
                 idle_polls = 0
@@ -372,6 +374,11 @@ class TcpMessaging(MessagingService):
                 idle_polls += 1
                 if idle_polls > 50:  # ~10s with frames outstanding, no ACK
                     raise OSError("peer not acking")
+            except DeserializationError as e:
+                # A peer speaking garbage (unframeable stream or undecodable
+                # frame) is as dead as a closed one: reconnect + redeliver
+                # rather than killing the bridge thread.
+                raise OSError(f"unreadable ack stream: {e}") from e
 
     # -- receiving ---------------------------------------------------------
 
@@ -393,6 +400,9 @@ class TcpMessaging(MessagingService):
             t = threading.Thread(target=self._serve_connection, args=(conn,),
                                  daemon=True)
             t.start()
+            # Prune finished reader threads so repeated connect/drop cycles
+            # (port scanners) don't grow this list without bound.
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _serve_connection(self, conn: socket.socket) -> None:
@@ -421,6 +431,16 @@ class TcpMessaging(MessagingService):
                     if kind != "msg":
                         continue
                     _, topic, session_id, unique_id, shost, sport, data = decoded
+                    # Field TYPES are part of the wire contract: hostile
+                    # well-formed frames with wrong-typed fields must die
+                    # here, not on the node's pump thread (dedupe hashes
+                    # unique_id; TopicSession expects str/int).
+                    if not (isinstance(topic, str)
+                            and isinstance(session_id, int)
+                            and isinstance(unique_id, bytes)
+                            and isinstance(shost, str)
+                            and isinstance(sport, int)):
+                        continue
                 except (DeserializationError, ValueError, IndexError,
                         TypeError, KeyError):
                     # Junk from the wire — including well-framed frames that

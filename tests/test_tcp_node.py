@@ -283,6 +283,12 @@ class TestHostileSocket:
 
             scalar = bytes(serialize(7).bytes)
             payloads.append(struct.pack(">I", len(scalar)) + scalar)
+            # a well-formed 'msg' frame with WRONG-TYPED fields (dict where
+            # the dedupe id must be bytes) must die at the reader, not on
+            # the node's pump thread
+            evil = bytes(serialize(
+                ("msg", "platform.session", 0, {"a": 1}, "h", 1, b"")).bytes)
+            payloads.append(struct.pack(">I", len(evil)) + evil)
             for payload in payloads:
                 s = socket.create_connection(addr, timeout=2)
                 try:
@@ -298,5 +304,67 @@ class TestHostileSocket:
             pump_until(nodes, lambda: h.result.done)
             h.result.result().verify(stx.id.bytes)
         finally:
+            for n in nodes:
+                n.stop()
+
+
+    @pytest.mark.filterwarnings(
+        "error::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_garbage_acking_peer_does_not_kill_the_bridge(self, tmp_path):
+        """An outbound bridge whose peer replies with garbage instead of
+        ACK frames must reconnect-and-retry, not lose its thread — and the
+        node keeps serving other peers."""
+        import socket
+        import threading
+
+        from corda_tpu.node.messaging.api import TopicSession
+        from corda_tpu.node.messaging.tcp import TcpAddress
+
+        notary = make_node(tmp_path, "Notary", notary="simple")
+        alice = make_node(tmp_path, "Alice")
+        nodes = [notary, alice]
+
+        fake = socket.socket()
+        fake.bind(("127.0.0.1", 0))
+        fake.listen(4)
+        fake_addr = TcpAddress("127.0.0.1", fake.getsockname()[1])
+        hits = []
+
+        def fake_peer():
+            fake.settimeout(5)
+            try:
+                while len(hits) < 2:  # original connect + >=1 reconnect
+                    conn, _ = fake.accept()
+                    hits.append(1)
+                    conn.settimeout(2)
+                    try:
+                        conn.recv(4096)  # the bridged frame
+                        conn.sendall(b"\xde\xad\xbe\xef" * 4)  # garbage
+                    except OSError:
+                        pass
+                    conn.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=fake_peer, daemon=True)
+        t.start()
+        try:
+            for n in nodes:
+                n.refresh_netmap()
+            alice.messaging.send(TopicSession("platform.session", 0),
+                                 b"payload", fake_addr)
+            deadline = __import__("time").monotonic() + 6
+            while __import__("time").monotonic() < deadline and len(hits) < 2:
+                for n in nodes:
+                    n.run_once(timeout=0.01)
+            assert len(hits) >= 2, "bridge never reconnected after garbage"
+            # the node still serves legitimate peers
+            stx = issue_and_move(alice, notary.identity, magic=88)
+            h = alice.start_flow(NotaryClientFlow(stx))
+            pump_until(nodes, lambda: h.result.done)
+            h.result.result().verify(stx.id.bytes)
+        finally:
+            fake.close()
+            t.join(timeout=2)
             for n in nodes:
                 n.stop()
