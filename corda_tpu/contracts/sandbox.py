@@ -103,6 +103,11 @@ FORBIDDEN_BUILTINS = frozenset({
 
 # Modules whose code a contract may call into. The ledger data model is
 # trusted (it is the platform), plus a small pure-math stdlib allowance.
+# `operator` is deliberately absent: attrgetter/methodcaller take string
+# attribute names and would bypass the FORBIDDEN_ATTRS LOAD_ATTR check
+# (operator.attrgetter('__globals__') reaches real builtins). `copy` and
+# `re` are absent because their module-level caches (_copy_dispatch,
+# re._cache) are mutable via STORE_SUBSCR, which static vetting cannot see.
 DEFAULT_MODULE_WHITELIST = (
     "corda_tpu.contracts",
     "corda_tpu.crypto",
@@ -110,8 +115,8 @@ DEFAULT_MODULE_WHITELIST = (
     "corda_tpu.serialization",
     "corda_tpu.transactions",
     "math", "cmath", "decimal", "fractions", "itertools", "functools",
-    "operator", "dataclasses", "enum", "typing", "abc", "numbers", "re",
-    "collections", "copy", "string",
+    "dataclasses", "enum", "typing", "abc", "numbers",
+    "collections",
 )
 
 # Reflection attributes that escape any static whitelist if reachable
@@ -120,6 +125,10 @@ FORBIDDEN_ATTRS = frozenset({
     "__globals__", "__builtins__", "__code__", "__closure__", "__dict__",
     "__subclasses__", "__getattribute__", "__reduce__", "__reduce_ex__",
     "__loader__", "__spec__", "__import__", "gi_frame", "f_globals",
+    # str.format's replacement-field mini-language does attribute traversal
+    # ("{0.__globals__}") outside any LOAD_ATTR the vetter can see; f-strings
+    # compile to real LOAD_ATTR opcodes and stay usable.
+    "format", "format_map", "vformat",
 })
 
 # Exception types are fine to reference (contracts raise to reject).
@@ -182,7 +191,18 @@ class DeterministicSandbox:
                   closure: dict | None = None) -> None:
         if code in self._vetted:
             return
+        # Mark before recursing so cycles terminate, but UNWIND on failure:
+        # leaving a failed code object in the cache would let the same
+        # malicious contract pass a later vet on this sandbox instance.
         self._vetted.add(code)
+        try:
+            self._vet_code_inner(code, globs, closure)
+        except BaseException:
+            self._vetted.discard(code)
+            raise
+
+    def _vet_code_inner(self, code: types.CodeType, globs: dict,
+                        closure: dict | None = None) -> None:
         where = f"{code.co_filename}:{code.co_name}"
 
         for inst in dis.get_instructions(code):
@@ -215,6 +235,19 @@ class DeterministicSandbox:
         for const in code.co_consts:
             if isinstance(const, types.CodeType):
                 self._vet_code(const, globs)
+            elif isinstance(const, str):
+                # Reflection attribute names smuggled as *data* — e.g. a
+                # string handed to a platform helper that does attribute
+                # lookup. Defense in depth only: a string assembled at
+                # runtime ("__glo"+"bals__" via join) evades a constant
+                # scan, which is why str.format itself is banned via
+                # FORBIDDEN_ATTRS above. Scan for the dunder names only;
+                # "format" itself appears in ordinary message text.
+                for banned in FORBIDDEN_ATTRS:
+                    if banned.startswith("__") and banned in const:
+                        raise SandboxViolation(
+                            f"{where}: string constant embeds reflection "
+                            f"attribute {banned!r}")
 
     def _vet_name(self, name: str, globs: dict, where: str) -> None:
         if name in FORBIDDEN_BUILTINS:
@@ -252,10 +285,7 @@ class DeterministicSandbox:
             self.vet(value)
             return
         if isinstance(value, type):
-            for attr in vars(value).values():
-                func = getattr(attr, "__func__", attr)
-                if isinstance(func, types.FunctionType):
-                    self.vet(func)
+            self._vet_class(value, where)
             return
         if isinstance(value, (int, float, str, bytes, bool, tuple, frozenset,
                               complex)) or value is None:
@@ -264,12 +294,101 @@ class DeterministicSandbox:
             f"{where}: global {name!r} of type {type(value).__name__} from "
             f"non-whitelisted module {mod!r}")
 
+    def _vet_class(self, cls: type, where: str,
+                   seen: set[type] | None = None) -> None:
+        """Vet every executable attribute of a user class: plain functions,
+        class/static methods, property fget/fset/fdel, functools.wraps
+        chains, nested classes, and user base classes. (The round-2 advisor
+        showed the function-only walk let code smuggled in a property run
+        unconfined.)"""
+        seen = set() if seen is None else seen
+        if cls in seen:
+            return
+        seen.add(cls)
+        for base in cls.__bases__:
+            mod = getattr(base, "__module__", "") or ""
+            if mod == "builtins" or _module_allowed(
+                    mod, self.module_whitelist):
+                continue
+            self._vet_class(base, where, seen)
+        for name, attr in vars(cls).items():
+            if name in ("__dict__", "__weakref__", "__doc__", "__module__",
+                        "__qualname__", "__firstlineno__",
+                        "__static_attributes__", "__slots__",
+                        "__annotations__", "__match_args__",
+                        "__dataclass_fields__", "__dataclass_params__",
+                        "__parameters__", "__orig_bases__", "__hash__",
+                        "__abstractmethods__", "_abc_impl"):
+                continue
+            attr = getattr(attr, "__func__", attr)  # class/staticmethod
+            if isinstance(attr, property):
+                for accessor in (attr.fget, attr.fset, attr.fdel):
+                    if accessor is not None:
+                        self.vet(accessor)
+                continue
+            wrapped = getattr(attr, "__wrapped__", None)
+            if isinstance(wrapped, (types.FunctionType, types.MethodType)):
+                self.vet(wrapped)
+            if isinstance(attr, (types.FunctionType, types.MethodType)):
+                self.vet(attr)
+                continue
+            if isinstance(attr, type):
+                self._vet_class(attr, where, seen)
+                continue
+            if attr is None or isinstance(
+                    attr, (int, float, str, bytes, bool, tuple, frozenset,
+                           complex)):
+                continue
+            # Arbitrary descriptors (functools.cached_property, user
+            # __get__ objects, …) carry code the simple walk above misses:
+            # vet every embedded callable we can find, and FAIL CLOSED on
+            # attributes we cannot see into — an unrecognised mutable or
+            # executable class attribute is exactly where smuggled code or
+            # cross-replay state hides.
+            vetted_embedded = False
+            for accessor_name in ("func", "fget", "fset", "fdel",
+                                  "__wrapped__", "__call__"):
+                f = getattr(attr, accessor_name, None)
+                f = getattr(f, "__func__", f)
+                if isinstance(f, (types.FunctionType, types.MethodType)):
+                    self.vet(f)
+                    vetted_embedded = True
+            if not vetted_embedded:
+                raise SandboxViolation(
+                    f"{where}: unvettable class attribute {name!r} of type "
+                    f"{type(attr).__name__}")
+
     # ----------------------------------------------------------- execution
+
+    def _confine(self, fn):
+        """Rebuild a *user* entry function over a globals dict whose
+        ``__builtins__`` holds only the allowed names — runtime defense in
+        depth behind static vetting, the same belt-and-braces the
+        attachments loader uses. Platform (whitelisted-module) functions run
+        unmodified."""
+        fn = getattr(fn, "__func__", fn)
+        if _module_allowed(getattr(fn, "__module__", "") or "",
+                           self.module_whitelist):
+            return fn
+        code = getattr(fn, "__code__", None)
+        if code is None:
+            return fn
+        restricted = {n: getattr(builtins, n)
+                      for n in (ALLOWED_BUILTINS | _EXCEPTION_NAMES)
+                      if hasattr(builtins, n)}
+        restricted["__build_class__"] = builtins.__build_class__
+        globs = dict(fn.__globals__)
+        globs["__builtins__"] = restricted
+        confined = types.FunctionType(
+            code, globs, fn.__name__, fn.__defaults__, fn.__closure__)
+        confined.__kwdefaults__ = fn.__kwdefaults__
+        return confined
 
     def run(self, fn, *args, **kwargs):
         """Vet, then execute under the cost tracer. Returns fn's result;
         raises SandboxViolation / SandboxCostExceeded."""
         self.vet(fn)
+        fn = self._confine(fn)
         budget = self.budget
         counts = {"jump": 0, "invoke": 0, "throw": 0}
 

@@ -351,6 +351,17 @@ def all_of(*arrangements: Arrangement) -> Arrangement:
     return All(frozenset(flat))
 
 
+def _flat_parts(arr: Arrangement) -> list:
+    """Flatten an arrangement into its conjunct leaves (nested Alls opened,
+    Zeros dropped). Used for multiset output comparison in UAction."""
+    if isinstance(arr, All):
+        parts: list = []
+        for a in arr.arrangements:  # callers sort the flattened result
+            parts.extend(_flat_parts(a))
+        return parts
+    return [] if arr == ZERO else [arr]
+
+
 # ---------------------------------------------------------------------------
 # Structural utilities (Util.kt)
 # ---------------------------------------------------------------------------
@@ -773,19 +784,23 @@ class UniversalContract(Contract):
                 req("exercising an action must consume the whole state",
                     extract_remainder(arr, action) == ZERO)
             result = self._validate_transfers(tx, action.arrangement)
-            if not tx.outputs:
-                with require_that() as req:
-                    req("action result must be Zero for an output-less "
-                        "transaction", result == ZERO)
-            elif len(tx.outputs) == 1:
-                with require_that() as req:
-                    req("output state must match action result state",
-                        result == tx.outputs[0].details)
-            else:
-                combined = all_of(*(o.details for o in tx.outputs))
-                with require_that() as req:
-                    req("output states must match action result state",
-                        result == combined)
+            # Compare outputs to the action result as a MULTISET of flattened
+            # parts, not via all_of: All's frozenset collapses duplicates, so
+            # outputs [X, Y, Y] would compare equal to All{X, Y} and an
+            # authorized actor could mint duplicate obligation states
+            # (round-2 advisor finding). Element-for-element on sorted part
+            # lists makes duplication visible.
+            out_details = []
+            for o in tx.outputs:
+                if not isinstance(o, UniversalState):
+                    raise ValueError("output state is not a UniversalState")
+                out_details.append(o.details)
+            expected = sorted(_flat_parts(result), key=repr)
+            produced = sorted(
+                (p for d in out_details for p in _flat_parts(d)), key=repr)
+            with require_that() as req:
+                req("output states must match action result state "
+                    "part-for-part", produced == expected)
 
         elif isinstance(value, UApplyFixes):
             in_state = self._single_state(tx.inputs, "input")

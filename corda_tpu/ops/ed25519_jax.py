@@ -418,9 +418,15 @@ def precompute_batch_device(pubkeys, msgs, sigs, bucket: int | None = None):
     batched device graph (ops/sha512_jax.py)."""
     n = len(sigs)
     b = bucket or pick_bucket(n)
-    m_cat = b"".join(bytes(m) for m in msgs)
-    if len(m_cat) != 32 * n:
+    # Per-message check, not aggregate: mixed lengths summing to 32*n would
+    # silently re-split at 32-byte boundaries and verify against scrambled
+    # messages (round-2 advisor finding).
+    raw = [bytes(m) for m in msgs]
+    if len(raw) != n or len(pubkeys) != n:
+        raise ValueError("pubkeys, msgs and sigs must have equal length")
+    if any(len(m) != 32 for m in raw):
         raise ValueError("device-hash path requires 32-byte messages")
+    m_cat = b"".join(raw)
     _, _, pk, r_enc, s_raw = _pack_pk_rs(pubkeys, sigs, n, b)
     m_raw = np.zeros((b, 32), np.uint8)
     m_raw[:n] = np.frombuffer(m_cat, np.uint8).reshape(n, 32)

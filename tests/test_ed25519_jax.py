@@ -265,3 +265,20 @@ def test_device_and_host_hash_paths_agree():
     host = np.asarray(kernel.verify_arrays_auto(*host_arrays))
     dev = np.asarray(kernel.verify_arrays_hashed(*dev_arrays))
     assert host.tolist() == dev.tolist()
+
+
+def test_device_hash_path_rejects_mixed_length_messages():
+    # Round-2 advisor finding: messages of mixed length summing to 32*n were
+    # silently re-split at 32-byte boundaries and verified against scrambled
+    # messages. Each message must be exactly 32 bytes.
+    pks, msgs, sigs = [], [], []
+    for i in range(2):
+        seed, pk = _keypair(400 + i)
+        m = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(seed, m))
+    # 31 + 33 = 64 = 32*2: aggregate length check would pass this.
+    msgs = [msgs[0][:31], msgs[1] + b"\x00"]
+    with pytest.raises(ValueError, match="32-byte"):
+        kernel.precompute_batch_device(pks, msgs, sigs, bucket=32)

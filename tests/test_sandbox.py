@@ -118,6 +118,131 @@ class TestVetting:
         with pytest.raises(SandboxViolation, match="getattr"):
             DeterministicSandbox().vet_contract(EvilContract())
 
+    def test_attrgetter_escape_rejected(self):
+        # operator.attrgetter('__globals__') passed static vetting while
+        # `operator` was whitelisted, bypassing both the getattr ban and the
+        # FORBIDDEN_ATTRS LOAD_ATTR check (round-2 advisor finding). Two
+        # independent layers must now stop it: `operator` is no longer
+        # whitelisted, and the reflection string constant itself fails
+        # vetting.
+        import operator
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                getter = operator.attrgetter("__globals__")
+                return getter(type(tx).verify)
+
+        with pytest.raises(SandboxViolation):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_reflection_string_constant_rejected(self):
+        # "{0.__globals__}".format(fn) reaches reflection through the
+        # *allowed* format builtin; the string-constant scan fails it closed.
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return "x.__globals__"  # data smuggled to a lookup helper
+
+        with pytest.raises(SandboxViolation, match="string constant"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_str_format_banned(self):
+        # "{0.__globals__}".format(fn) does attribute traversal inside the
+        # format mini-language, invisible to the LOAD_ATTR check — and the
+        # string can be assembled at runtime to evade the constant scan. The
+        # format attribute itself is therefore forbidden (f-strings compile
+        # to real LOAD_ATTR opcodes and remain usable).
+        class EvilContract(Contract):
+            def verify(self, tx):
+                tmpl = "".join(["{0.__glo", "bals__}"])
+                return tmpl.format(type(tx).verify)
+
+        with pytest.raises(SandboxViolation, match="format"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_failed_vet_is_not_cached(self):
+        # A failed vet must not poison the vetted-cache: the same sandbox
+        # re-vetting the same malicious contract must fail again, not pass.
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return open("/etc/passwd")
+
+        sandbox = DeterministicSandbox()
+        assert not sandbox.is_suitable(EvilContract())
+        assert not sandbox.is_suitable(EvilContract())
+        with pytest.raises(SandboxViolation, match="open"):
+            sandbox.run(EvilContract.verify, EvilContract(), None)
+
+    def test_cached_property_is_vetted(self):
+        # functools is whitelisted, so a cached_property instance passes
+        # the module check; its wrapped function must still be vetted.
+        import functools
+
+        class Helper:
+            @functools.cached_property
+            def now(self):
+                return time.time()
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return Helper().now
+
+        with pytest.raises(SandboxViolation):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_property_accessor_is_vetted(self):
+        # Code smuggled in a property on a helper class previously ran
+        # unvetted (round-2 advisor finding).
+        class Helper:
+            @property
+            def now(self):
+                return time.time()
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return Helper().now
+
+        with pytest.raises(SandboxViolation, match="time"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_nested_class_is_vetted(self):
+        class Outer:
+            class Inner:
+                def leak(self):
+                    return open("/etc/passwd")
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return Outer.Inner().leak()
+
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_user_base_class_is_vetted(self):
+        class EvilBase:
+            def helper(self):
+                return time.time()
+
+        class Derived(EvilBase):
+            pass
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return Derived().helper()
+
+        with pytest.raises(SandboxViolation, match="time"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_runtime_builtins_are_restricted(self):
+        # Defense in depth: even if static vetting were bypassed, the entry
+        # function executes over a restricted __builtins__ mapping.
+        class Contract2(Contract):
+            def verify(self, tx):
+                return eval("1+1")  # noqa: S307 — the point of the test
+
+        confined = DeterministicSandbox()._confine(Contract2.verify)
+        with pytest.raises(NameError):
+            confined(Contract2(), None)
+
     def test_global_mutation_rejected(self):
         class EvilContract(Contract):
             def verify(self, tx):

@@ -248,6 +248,25 @@ class TestFXSwap:
             tx.timestamp(day_ts(MATURITY))
             tx.fails_with("match action result")
 
+    def test_duplicate_output_mint_rejected(self):
+        # Round-2 advisor finding: outputs [X, Y, Y] compared equal to
+        # All{X, Y} because all_of's frozenset collapses duplicates — an
+        # authorized actor could mint a duplicate obligation state. The
+        # multiset comparison must reject the duplicated leg.
+        usd_leg = transfer(
+            Const(to_quanta(1_200_000)), "USD", ACME, HIGH_ST)
+        eur_leg = transfer(
+            Const(to_quanta(1_000_000)), "EUR", HIGH_ST, ACME)
+        l = ledger(NOTARY)
+        with l.transaction() as tx:
+            tx.input(ustate(self.swap))
+            tx.output(None, ustate(usd_leg))
+            tx.output(None, ustate(eur_leg))
+            tx.output(None, ustate(eur_leg))
+            tx.command(UAction("execute"), ACME.owning_key)
+            tx.timestamp(day_ts(MATURITY))
+            tx.fails_with("match action result")
+
 
 class TestFixings:
     """reference: Caplet.kt/Cap.kt fixing flow — UApplyFixes substitutes an
