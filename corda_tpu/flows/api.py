@@ -295,19 +295,28 @@ class FlowLogic:
 
     # -- checkpoint support ------------------------------------------------
 
+    _ckpt_params_cache: dict = {}  # flow class -> constructor param names
+
     def checkpoint_args(self) -> tuple:
         """The constructor arguments, recovered by signature convention."""
-        sig = inspect.signature(type(self).__init__)
+        cls = type(self)
+        pnames = FlowLogic._ckpt_params_cache.get(cls)
+        if pnames is None:
+            sig = inspect.signature(cls.__init__)
+            pnames = []
+            for pname, param in list(sig.parameters.items())[1:]:  # skip self
+                if param.kind in (param.VAR_POSITIONAL, param.VAR_KEYWORD):
+                    raise FlowException(
+                        f"{cls.__name__}: *args/**kwargs constructors are not "
+                        "checkpointable; use explicit parameters"
+                    )
+                pnames.append(pname)
+            pnames = FlowLogic._ckpt_params_cache[cls] = tuple(pnames)
         args = []
-        for pname, param in list(sig.parameters.items())[1:]:  # skip self
-            if param.kind in (param.VAR_POSITIONAL, param.VAR_KEYWORD):
-                raise FlowException(
-                    f"{type(self).__name__}: *args/**kwargs constructors are not "
-                    "checkpointable; use explicit parameters"
-                )
+        for pname in pnames:
             if not hasattr(self, pname):
                 raise FlowException(
-                    f"{type(self).__name__}: constructor parameter {pname!r} must be "
+                    f"{cls.__name__}: constructor parameter {pname!r} must be "
                     "stored as attribute self.{pname} for checkpointing"
                 )
             args.append(getattr(self, pname))

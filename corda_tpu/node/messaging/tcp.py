@@ -80,7 +80,7 @@ class _Outbox:
                 self._db.conn.execute(
                     "INSERT INTO outbox (peer, unique_id, blob) VALUES (?, ?, ?)",
                     (peer, unique_id, frame))
-                self._db.conn.commit()
+                self._db.commit()
         else:
             with self._lock:
                 self._mem_seq += 1
@@ -89,20 +89,36 @@ class _Outbox:
     def pending(self, peer: str) -> list[tuple[int, bytes, bytes]]:
         """[(seq, unique_id, frame)] in order for one peer."""
         if self._db is not None:
-            with self._lock:
-                rows = self._db.conn.execute(
+            with self._db.aux_lock:
+                rows = self._db.aux_conn.execute(
                     "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
                     "ORDER BY seq", (peer,)).fetchall()
             return [(s, bytes(u), bytes(b)) for s, u, b in rows]
         with self._lock:
             return [(s, u, f) for s, p, u, f in self._mem if p == peer]
 
+    def pending_after(self, peer: str, after_seq: int,
+                      limit: int = 512) -> list[tuple[int, bytes, bytes]]:
+        """Incremental form of pending(): only rows newer than after_seq —
+        the replay loop polls this every 200 ms, and re-materialising the
+        whole backlog each poll was O(backlog) of blob copies per peer."""
+        if self._db is not None:
+            with self._db.aux_lock:
+                rows = self._db.aux_conn.execute(
+                    "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
+                    "AND seq > ? ORDER BY seq LIMIT ?",
+                    (peer, after_seq, limit)).fetchall()
+            return [(s, bytes(u), bytes(b)) for s, u, b in rows]
+        with self._lock:
+            return [(s, u, f) for s, p, u, f in self._mem
+                    if p == peer and s > after_seq][:limit]
+
     def count(self, peer: str) -> int:
         """Pending-frame count WITHOUT materialising blobs (polled per
         heartbeat by consensus backpressure)."""
         if self._db is not None:
-            with self._lock:
-                (n,) = self._db.conn.execute(
+            with self._db.aux_lock:
+                (n,) = self._db.aux_conn.execute(
                     "SELECT COUNT(*) FROM outbox WHERE peer = ?",
                     (peer,)).fetchone()
             return n
@@ -111,8 +127,8 @@ class _Outbox:
 
     def peers(self) -> set[str]:
         if self._db is not None:
-            with self._lock:
-                rows = self._db.conn.execute(
+            with self._db.aux_lock:
+                rows = self._db.aux_conn.execute(
                     "SELECT DISTINCT peer FROM outbox").fetchall()
             return {r[0] for r in rows}
         with self._lock:
@@ -120,43 +136,73 @@ class _Outbox:
 
     def ack(self, unique_id: bytes) -> None:
         if self._db is not None:
-            with self._lock:
-                self._db.conn.execute(
-                    "DELETE FROM outbox WHERE unique_id = ?", (unique_id,))
-                self._db.conn.commit()
+            import sqlite3
+
+            try:
+                with self._db.aux_lock:
+                    self._db.aux_conn.execute(
+                        "DELETE FROM outbox WHERE unique_id = ?", (unique_id,))
+                    self._db.aux_conn.commit()
+            except sqlite3.OperationalError:
+                # Write lock held past busy_timeout (an unusually long node
+                # round): leave the row; the replay loop redelivers and the
+                # receiver's dedupe + re-ACK retire it next pass.
+                pass
         else:
             with self._lock:
                 self._mem = [e for e in self._mem if e[2] != unique_id]
 
 
 class _Dedupe:
-    """Durable (sqlite) or in-memory set of processed message ids."""
+    """Durable (sqlite) or in-memory set of processed message ids.
+
+    The durable form keeps a process-lifetime in-memory mirror of every id
+    recorded OR looked up this process, so the per-message hot path costs a
+    set lookup; sqlite is consulted only on a cold miss (ids recorded by a
+    previous process) and remains the durable truth."""
 
     def __init__(self, db=None):
         self._db = db
         self._mem: set[bytes] = set()
+        self._round_recorded: list[bytes] = []
         self._lock = threading.Lock()
 
     def seen(self, unique_id: bytes) -> bool:
-        if self._db is not None:
-            with self._lock:
-                row = self._db.conn.execute(
-                    "SELECT 1 FROM dedupe WHERE message_id = ?",
-                    (unique_id,)).fetchone()
-            return row is not None
         with self._lock:
-            return unique_id in self._mem
+            if unique_id in self._mem:
+                return True
+        if self._db is None:
+            return False
+        with self._lock:
+            row = self._db.conn.execute(
+                "SELECT 1 FROM dedupe WHERE message_id = ?",
+                (unique_id,)).fetchone()
+            if row is not None:
+                self._mem.add(unique_id)
+            return row is not None
 
     def record(self, unique_id: bytes) -> None:
-        if self._db is not None:
-            with self._lock:
+        with self._lock:
+            self._mem.add(unique_id)
+            if self._db is not None:
+                if self._db.in_batch:
+                    # The sqlite row rides the round transaction; if the
+                    # round aborts, the mirror entry must go with it or a
+                    # redelivery would be swallowed un-durably.
+                    self._round_recorded.append(unique_id)
                 self._db.conn.execute(
                     "INSERT OR IGNORE INTO dedupe (message_id) VALUES (?)",
                     (unique_id,))
-                self._db.conn.commit()
-        else:
-            with self._lock:
-                self._mem.add(unique_id)
+                self._db.commit()
+
+    def round_committed(self) -> None:
+        self._round_recorded.clear()
+
+    def round_aborted(self) -> None:
+        with self._lock:
+            for unique_id in self._round_recorded:
+                self._mem.discard(unique_id)
+            self._round_recorded.clear()
 
 
 def _send_frame(sock: socket.socket, frame: bytes) -> None:
@@ -225,6 +271,12 @@ class TcpMessaging(MessagingService):
         self._lock = threading.Lock()
         self._running = False
         self._address: TcpAddress | None = None
+        # Round-deferral state (db.batch() rounds): ACKs for messages whose
+        # processing rode a still-open round transaction, and bridge wakeups
+        # for frames whose outbox rows are not yet committed. Flushed by
+        # flush_round() AFTER the round commit.
+        self._deferred_acks: list[tuple[Any, bytes]] = []
+        self._deferred_bridge_peers: set[str] = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -283,7 +335,12 @@ class TcpMessaging(MessagingService):
         )).bytes
         peer = str(to)
         self._outbox.append(peer, unique_id, frame)
-        self._ensure_bridge(peer)
+        if self._db is not None and self._db.in_batch:
+            # The row isn't committed yet; bridges read via the aux
+            # connection and would see nothing. Wake them after the round.
+            self._deferred_bridge_peers.add(peer)
+        else:
+            self._ensure_bridge(peer)
 
     def outbox_backlog(self, to) -> int:
         """Undelivered (un-ACKed) frames queued for a peer — lets protocols
@@ -314,6 +371,12 @@ class TcpMessaging(MessagingService):
                 pending = self._outbox.pending(peer)
             except sqlite3.ProgrammingError:
                 return  # db closed: the node is shutting down
+            except sqlite3.OperationalError:
+                pending = None  # transient lock contention: back off, retry
+            if pending is None:
+                wakeup.clear()
+                wakeup.wait(timeout=0.05)
+                continue
             if not pending:
                 wakeup.clear()
                 wakeup.wait(timeout=1.0)
@@ -347,18 +410,30 @@ class TcpMessaging(MessagingService):
         """Stream outbox frames and consume ACKs concurrently (no head-of-line
         blocking: frames enqueued while earlier ones await ACK still go out).
         Returns when the outbox is empty; raises OSError to trigger
-        reconnect + redeliver when the peer stalls or drops."""
+        reconnect + redeliver when the peer stalls or drops.
+
+        Frames are fetched INCREMENTALLY (seq > last sent) so steady-state
+        polls touch only new rows; un-ACKed frames from this connection are
+        tracked in `sent` and re-sent only after a reconnect."""
         sock.settimeout(0.2)
         sent: set[bytes] = set()
+        last_seq = 0
         idle_polls = 0
         while self._running:
-            pending = self._outbox.pending(peer)
-            if not pending:
-                return
-            for _seq, unique_id, frame in pending:
+            batch = self._outbox.pending_after(peer, last_seq)
+            if not batch and not sent:
+                if self._outbox.count(peer) == 0:
+                    return  # truly drained (acks may have raced last_seq)
+                # Rows at/below last_seq remain un-ACKed from a PREVIOUS
+                # connection: resend them once from scratch.
+                last_seq = 0
+                sent.clear()
+                continue
+            for seq, unique_id, frame in batch:
                 if unique_id not in sent:
                     _send_frame(sock, frame)
                     sent.add(unique_id)
+                last_seq = max(last_seq, seq)
             try:
                 frame = _recv_frame(sock)
                 if frame is None:
@@ -372,7 +447,7 @@ class TcpMessaging(MessagingService):
                 idle_polls = 0
             except socket.timeout:
                 idle_polls += 1
-                if idle_polls > 50:  # ~10s with frames outstanding, no ACK
+                if sent and idle_polls > 50:  # ~10s outstanding, no ACK
                     raise OSError("peer not acking")
             except DeserializationError as e:
                 # A peer speaking garbage (unframeable stream or undecodable
@@ -484,12 +559,17 @@ class TcpMessaging(MessagingService):
     def remove_message_handler(self, registration: MessageHandlerRegistration) -> None:
         self._handlers.remove(registration)
 
-    def pump(self, timeout: float = 0.0) -> int:
+    def pump(self, timeout: float = 0.0, max_messages: int | None = None
+             ) -> int:
         """Dispatch queued inbound messages on THIS thread; ACK after
         processing. Returns number dispatched. timeout>0 blocks for the
-        first message."""
-        n = 0
+        first message. max_messages bounds one pump call so a round (and its
+        db transaction, which holds the sqlite write lock) stays short under
+        firehose load — leftover messages are dispatched next round."""
+        n = attempts = 0
         while True:
+            if max_messages is not None and attempts >= max_messages:
+                return n
             first_blocking = n == 0 and timeout > 0
             try:
                 conn, message = self._inbound.get(
@@ -497,6 +577,7 @@ class TcpMessaging(MessagingService):
                     timeout=timeout if first_blocking else None)
             except queue.Empty:
                 return n
+            attempts += 1
             if self._dispatch(conn, message):
                 n += 1
 
@@ -550,8 +631,35 @@ class TcpMessaging(MessagingService):
         # production topic here has exactly one handler anyway).
         self._poison.pop(message.unique_id, None)
         self._dedupe.record(message.unique_id)
-        self._ack(conn, message.unique_id)
+        if self._db is not None and self._db.in_batch:
+            # The dedupe record (and everything processing wrote) commits at
+            # round end; ACKing before that commit would let a crash lose
+            # the message with the sender believing it delivered.
+            self._deferred_acks.append((conn, message.unique_id))
+        else:
+            self._ack(conn, message.unique_id)
         return succeeded > 0
+
+    def flush_round(self) -> None:
+        """Release round-deferred effects. MUST be called after the round's
+        db.batch() commit: sends the ACKs for every message processed in the
+        round and wakes bridges for frames the round enqueued."""
+        self._dedupe.round_committed()
+        acks, self._deferred_acks = self._deferred_acks, []
+        for conn, unique_id in acks:
+            self._ack(conn, unique_id)
+        peers, self._deferred_bridge_peers = self._deferred_bridge_peers, set()
+        for peer in peers:
+            self._ensure_bridge(peer)
+
+    def abort_round(self) -> None:
+        """Discard round-deferred effects after a ROLLED-BACK round: the
+        deferred ACKs must never be sent (their messages' processing was
+        rolled back — the senders must redeliver) and the dedupe mirror
+        unwinds the round's entries."""
+        self._dedupe.round_aborted()
+        self._deferred_acks.clear()
+        self._deferred_bridge_peers.clear()
 
     def _ack(self, conn, unique_id: bytes) -> None:
         if conn is None:

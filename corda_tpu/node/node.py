@@ -142,6 +142,7 @@ class Node:
             verifier=_make_verifier(config.verifier),
             our_identity=self.identity,
             defer_verify=True,  # the run loop owns the flush policy
+            defer_checkpoints=True,  # run_once flushes once per round
         )
 
         # -- notary --------------------------------------------------------
@@ -262,6 +263,7 @@ class Node:
         self.messaging.pump(timeout=0.001)
         if self.raft_member is not None:
             self.raft_member.tick()
+            self.raft_member.flush_appends()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -303,7 +305,14 @@ class Node:
 
     def run_once(self, timeout: float = 0.05) -> int:
         """One scheduling round: dispatch inbound messages, then apply the
-        max-wait micro-batch policy. Returns messages dispatched."""
+        max-wait micro-batch policy. Returns messages dispatched.
+
+        The whole round runs inside ONE db transaction (db.batch): every
+        checkpoint, outbox frame, dedupe record and commit-log write the
+        round produces becomes durable in a single commit, and only then
+        does the transport ACK the round's inbound messages + wake outbound
+        bridges (messaging.flush_round) — one fsync per round instead of
+        one per mutation, with the same at-least-once redelivery contract."""
         batch = self.config.batch
         wait = timeout
         if self.smm.verify_pending_sigs:
@@ -311,18 +320,38 @@ class Node:
             deadline = (self.smm.verify_waiting_since
                         + batch.max_wait_ms / 1e3)
             wait = max(0.0, min(timeout, deadline - time.monotonic()))
-        n = self.messaging.pump(timeout=wait)
-        if self.raft_member is not None:
-            self.raft_member.tick()
-        self.smm.poll_services()
-        self.scheduler.tick()
-        pending = self.smm.verify_pending_sigs
-        if pending and (
-            pending >= batch.max_sigs
-            or time.monotonic() - self.smm.verify_waiting_since
-            >= batch.max_wait_ms / 1e3
-        ):
-            self.smm.flush_pending_verifies()
+        try:
+            with self.db.batch():
+                n = self.messaging.pump(timeout=wait, max_messages=512)
+                if self.raft_member is not None:
+                    self.raft_member.tick()
+                self.smm.poll_services()
+                if self.raft_member is not None:
+                    # poll_services may have submitted commits; replicate
+                    # them in THIS round (one coalesced AppendEntries per
+                    # peer).
+                    self.raft_member.flush_appends()
+                self.scheduler.tick()
+                pending = self.smm.verify_pending_sigs
+                if pending and (
+                    pending >= batch.max_sigs
+                    or time.monotonic() - self.smm.verify_waiting_since
+                    >= batch.max_wait_ms / 1e3
+                ):
+                    self.smm.flush_pending_verifies()
+                self.smm.flush_checkpoints()
+        except BaseException:
+            # The round rolled back: its deferred ACKs must not be sent
+            # (senders redeliver) and in-memory flow state is now AHEAD of
+            # durable state — the process should be restarted; recovery
+            # replays from the last committed round.
+            abort = getattr(self.messaging, "abort_round", None)
+            if abort is not None:
+                abort()
+            raise
+        flush = getattr(self.messaging, "flush_round", None)
+        if flush is not None:
+            flush()
         return n
 
     def run_forever(self) -> None:

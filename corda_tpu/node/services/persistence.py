@@ -94,13 +94,94 @@ class NodeDatabase:
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(self._SCHEMA)
         self._conn.commit()
+        self._batch_depth = 0  # node-thread round batching (see batch())
+        self._batch_thread: int | None = None  # owning thread id
+        self._batch_failed = False
+        self._aux_conn: sqlite3.Connection | None = None
+        self.aux_lock = threading.Lock()
 
     @property
     def conn(self) -> sqlite3.Connection:
         return self._conn
 
+    @property
+    def aux_conn(self) -> sqlite3.Connection:
+        """A SECOND connection for transport bridge threads. While the node
+        thread holds a round transaction open on `conn` (batch()), a bridge
+        thread committing on the same connection would flush the half-built
+        round; the aux connection gives bridges their own transaction scope
+        (WAL handles the concurrency; busy_timeout rides out round commits).
+        Reads on this connection see only COMMITTED rows — an outbox frame
+        becomes sendable only once the round that produced it is durable."""
+        if self._aux_conn is None:
+            aux = sqlite3.connect(self.path, check_same_thread=False)
+            aux.execute("PRAGMA busy_timeout=5000")
+            self._aux_conn = aux
+        return self._aux_conn
+
+    @property
+    def in_batch(self) -> bool:
+        return (self._batch_depth > 0
+                and self._batch_thread == threading.get_ident())
+
+    def commit(self) -> None:
+        """Commit now — unless the CALLING thread holds an open round batch,
+        in which case the write becomes durable atomically with the whole
+        round at batch() exit. Other threads (webserver uploads) keep the
+        commit-before-return guarantee: batch() holds db.lock for the round,
+        so a foreign thread's write+commit (done under db.lock) can never
+        interleave into a half-built round transaction."""
+        if self.in_batch:
+            return
+        self._conn.commit()
+
+    def batch(self):
+        """Context manager: coalesce every store mutation issued on the node
+        thread into ONE sqlite transaction (one fsync instead of one per
+        checkpoint/outbox/dedupe write). The crash contract strengthens:
+        a round's checkpoint updates, outbound frames and dedupe records
+        commit atomically, and inbound ACKs are sent only after that commit
+        (TcpMessaging.flush_round), so a crash anywhere inside a round
+        redelivers cleanly. A round that RAISES rolls back as a unit —
+        committing a half-round would make dedupe records durable without
+        the checkpoints they belong with. Holds db.lock for the round
+        (re-entrant on the node thread); re-entrant."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _batch():
+            with self.lock:
+                if self._batch_depth == 0:
+                    self._batch_thread = threading.get_ident()
+                    self._batch_failed = False
+                self._batch_depth += 1
+                try:
+                    yield self
+                except BaseException:
+                    self._batch_failed = True
+                    raise
+                finally:
+                    self._batch_depth -= 1
+                    if self._batch_depth == 0:
+                        self._batch_thread = None
+                        try:
+                            if self._batch_failed:
+                                self._conn.rollback()
+                            else:
+                                self._conn.commit()
+                        except sqlite3.ProgrammingError:
+                            # close() raced the round (node.stop() from
+                            # another thread): equivalent to a crash mid-
+                            # round — the recovery contract (replay +
+                            # redelivery) covers it.
+                            pass
+
+        return _batch()
+
     def close(self) -> None:
         self._conn.close()
+        if self._aux_conn is not None:
+            self._aux_conn.close()
 
     def get_setting(self, key: str) -> str | None:
         row = self._conn.execute(
@@ -111,7 +192,7 @@ class NodeDatabase:
         self._conn.execute(
             "INSERT OR REPLACE INTO settings (key, value) VALUES (?, ?)",
             (key, value))
-        self._conn.commit()
+        self.commit()
 
     # -- node identity (reference: AbstractNode.kt:494-527 keypair on disk) --
 
@@ -145,12 +226,12 @@ class DBCheckpointStorage(CheckpointStorage):
         self._db.conn.execute(
             "INSERT OR REPLACE INTO checkpoints (run_id, blob) VALUES (?, ?)",
             (run_id, blob))
-        self._db.conn.commit()
+        self._db.commit()
 
     def remove_checkpoint(self, run_id: bytes) -> None:
         self._db.conn.execute(
             "DELETE FROM checkpoints WHERE run_id = ?", (run_id,))
-        self._db.conn.commit()
+        self._db.commit()
 
     def checkpoints(self) -> list[bytes]:
         return [bytes(b) for (b,) in self._db.conn.execute(
@@ -174,7 +255,7 @@ class DBTransactionStorage(TransactionStorage):
         cur = self._db.conn.execute(
             "INSERT OR IGNORE INTO transactions (tx_id, blob) VALUES (?, ?)",
             (stx.id.bytes, serialize(stx).bytes))
-        self._db.conn.commit()
+        self._db.commit()
         if cur.rowcount:
             for obs in list(self._observers):
                 obs(stx)
@@ -219,10 +300,15 @@ class DBAttachmentStorage(AttachmentStorage):
 
     def import_attachment(self, data: bytes) -> SecureHash:
         att_id = SecureHash.sha256(data)
-        self._db.conn.execute(
-            "INSERT OR IGNORE INTO attachments (att_id, data) VALUES (?, ?)",
-            (att_id.bytes, data))
-        self._db.conn.commit()
+        # db.lock: this is reachable from the webserver's HTTP thread; the
+        # lock (held by the node thread for each round transaction) keeps a
+        # foreign thread's insert+commit from interleaving into a half-built
+        # round, and commit() below is immediate for non-round threads.
+        with self._db.lock:
+            self._db.conn.execute(
+                "INSERT OR IGNORE INTO attachments (att_id, data) VALUES (?, ?)",
+                (att_id.bytes, data))
+            self._db.commit()
         return att_id
 
     def open_attachment(self, id: SecureHash):
@@ -261,7 +347,7 @@ class PersistentUniquenessProvider(UniquenessProvider):
                     "VALUES (?, ?)",
                     (serialize(ref).bytes,
                      serialize(ConsumingTx(tx_id, i, caller_identity)).bytes))
-            conn.commit()
+            self._db.commit()
 
     @property
     def committed_count(self) -> int:

@@ -225,6 +225,15 @@ class RaftMember:
         # applied — a client's periodic resubmission (liveness across leader
         # changes) must not append duplicate log entries on a slow quorum.
         self._appending: set[bytes] = set()
+        # In-memory mirror of recent log entries (idx -> (term, command)):
+        # replication re-reads the same entries once per broadcast per peer,
+        # and re-deserializing sqlite blobs each time made a 256-commit
+        # burst O(n^2) in codec work. Evicted on truncate/compaction.
+        self._entry_cache: dict[int, tuple[int, Any]] = {}
+        # Coalesced replication: submit() marks the log dirty and
+        # flush_appends()/tick() broadcasts ONCE per scheduling round — a
+        # burst of submissions previously triggered one full broadcast EACH.
+        self._append_dirty = False
         messaging.add_message_handler(RAFT_TOPIC, 0, self._on_message)
 
     # -- persistence -------------------------------------------------------
@@ -234,7 +243,7 @@ class RaftMember:
             self.db.conn.execute(
                 "UPDATE raft_meta SET term=?, voted_for=? WHERE singleton=1",
                 (self.term, self.voted_for))
-            self.db.conn.commit()
+            self.db.commit()
 
     def _log_last(self) -> tuple[int, int]:
         row = self.db.conn.execute(
@@ -248,6 +257,9 @@ class RaftMember:
             return 0
         if idx == self.snapshot_index:
             return self.snapshot_term
+        cached = self._entry_cache.get(idx)
+        if cached is not None:
+            return cached[0]
         row = self.db.conn.execute(
             "SELECT term FROM raft_log WHERE idx=?", (idx,)).fetchone()
         return None if row is None else row[0]
@@ -257,18 +269,33 @@ class RaftMember:
             self.db.conn.execute(
                 "INSERT OR REPLACE INTO raft_log (idx, term, blob) "
                 "VALUES (?, ?, ?)", (idx, term, serialize(command).bytes))
-            self.db.conn.commit()
+            self.db.commit()
+        self._entry_cache[idx] = (term, command)
 
     def _log_truncate_from(self, idx: int) -> None:
         with self.db.lock:
             self.db.conn.execute("DELETE FROM raft_log WHERE idx >= ?", (idx,))
-            self.db.conn.commit()
+            self.db.commit()
+        for i in [i for i in self._entry_cache if i >= idx]:
+            del self._entry_cache[i]
 
-    def _log_entries_from(self, idx: int, limit: int = 64):
+    def _log_entries_from(self, idx: int, limit: int = 256):
+        # Serve from the in-memory mirror when it covers the whole span.
+        last_idx, _ = self._log_last()
+        if idx > last_idx:
+            return []
+        span = range(idx, min(last_idx, idx + limit - 1) + 1)
+        if all(i in self._entry_cache for i in span):
+            return [(i, *self._entry_cache[i]) for i in span]
         rows = self.db.conn.execute(
             "SELECT idx, term, blob FROM raft_log WHERE idx >= ? "
             "ORDER BY idx LIMIT ?", (idx, limit)).fetchall()
-        return [(r[0], r[1], deserialize(bytes(r[2]))) for r in rows]
+        out = []
+        for r in rows:
+            entry = (r[0], r[1], deserialize(bytes(r[2])))
+            self._entry_cache[r[0]] = (entry[1], entry[2])
+            out.append(entry)
+        return out
 
     # -- timers (driven from the node's run loop) --------------------------
 
@@ -279,10 +306,22 @@ class RaftMember:
     def tick(self) -> None:
         now = self.clock()
         if self.role == "leader":
-            if now - self._last_heartbeat >= self.HEARTBEAT * self.scale:
-                self._broadcast_append()
+            if (self._append_dirty
+                    or now - self._last_heartbeat
+                    >= self.HEARTBEAT * self.scale):
+                self.flush_appends()
         elif now >= self._election_deadline:
             self._start_election()
+
+    def flush_appends(self) -> None:
+        """Replicate everything appended since the last broadcast (single
+        AppendEntries per peer per round, however many submissions the round
+        coalesced) and advance local commit bookkeeping."""
+        if self.role != "leader":
+            return
+        self._append_dirty = False
+        self._broadcast_append()
+        self._advance_commit()
 
     # -- roles -------------------------------------------------------------
 
@@ -332,8 +371,9 @@ class RaftMember:
             self._appending.add(command.request_id)
             last_idx, _ = self._log_last()
             self._log_append(last_idx + 1, self.term, command)
-            self._broadcast_append()
-            self._advance_commit()
+            # Coalesced: flush_appends()/tick() broadcasts once per round,
+            # covering every command submitted in the burst.
+            self._append_dirty = True
         elif self.leader_name is not None and self.leader_name in self.peers:
             self._send(self.peers[self.leader_name],
                        ClientCommit(command, self.name))
@@ -491,7 +531,9 @@ class RaftMember:
                 self.db.conn.execute(
                     "INSERT OR REPLACE INTO settings (key, value) "
                     "VALUES (?, ?)", (key, value))
-            self.db.conn.commit()
+            self.db.commit()
+        for i in [i for i in self._entry_cache if i <= upto]:
+            del self._entry_cache[i]
         self.snapshot_index, self.snapshot_term = upto, term
 
     def _on_install_snapshot(self, snap: InstallSnapshot, sender) -> None:
@@ -527,6 +569,7 @@ class RaftMember:
                     "INSERT OR REPLACE INTO committed_states "
                     "(state_ref, consuming) VALUES (?, ?)",
                     list(entries))
+                self._entry_cache.clear()
                 self.db.conn.execute("DELETE FROM raft_log")
                 for key, value in (
                         ("raft_snapshot_index",
@@ -539,7 +582,7 @@ class RaftMember:
                     self.db.conn.execute(
                         "INSERT OR REPLACE INTO settings (key, value) "
                         "VALUES (?, ?)", (key, value))
-                self.db.conn.commit()
+                self.db.commit()
             self.last_applied = snap.last_included_index
             self.commit_index = new_commit
             self.snapshot_index = snap.last_included_index

@@ -31,8 +31,10 @@ this deterministic: flows run until all are parked, then the batch flushes.
 from __future__ import annotations
 
 import hashlib
+import inspect as _inspect
 import logging
 import os
+import time as _time
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable
 
@@ -448,8 +450,6 @@ class FlowStateMachine:
     def step(self) -> None:
         """Advance the generator until it parks or finishes. Called only by
         the manager's pump (single-threaded)."""
-        import inspect as _inspect
-
         if self.state == _DONE:
             return
         try:
@@ -701,12 +701,24 @@ class StateMachineManager:
         our_identity: Party | None = None,
         token_context: "TokenContext | None" = None,
         defer_verify: bool = False,
+        defer_checkpoints: bool = False,
     ):
         # defer_verify: leave VerifyTxRequests queued until the scheduler
         # calls flush_pending_verifies() — lets a node accumulate sig checks
         # across ALL messages delivered in a scheduling round, maximising the
         # TPU batch (the max-wait micro-batching of SURVEY.md §7 stage 6).
         self.defer_verify = defer_verify
+        # defer_checkpoints: record WHICH flows changed and serialize/write
+        # each one ONCE per scheduling round (flush_checkpoints), instead of
+        # at every suspension — a flow suspends ~4-9 times per round on the
+        # notary path, and each eager write re-serialized the whole growing
+        # checkpoint. Sound because the design is replay-based: a crash
+        # re-runs from the last durable checkpoint and the transport
+        # redelivers anything un-ACKed (the node run loop flushes checkpoints
+        # inside the same db round-transaction that holds the round's outbox
+        # writes, and ACKs only after it commits).
+        self.defer_checkpoints = defer_checkpoints
+        self._dirty_checkpoints: dict[bytes, "FlowStateMachine"] = {}
         self.service_hub = service_hub
         self.messaging = messaging
         self.checkpoint_storage = (
@@ -760,7 +772,9 @@ class StateMachineManager:
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
         self._subscribe_progress(logic, run_id)
-        self._checkpoint(fsm)
+        # Write-through even in deferred mode: a freshly added flow (RPC
+        # start) must be durable before the caller learns its run id.
+        self._write_checkpoint(fsm)
         self._mark_runnable(fsm)
         self.changes.append(("add", run_id))
         self._pump()
@@ -785,6 +799,12 @@ class StateMachineManager:
     def _checkpoint(self, fsm: FlowStateMachine) -> None:
         if fsm.state == _DONE:
             return
+        if self.defer_checkpoints:
+            self._dirty_checkpoints[fsm.run_id] = fsm
+            return
+        self._write_checkpoint(fsm)
+
+    def _write_checkpoint(self, fsm: FlowStateMachine) -> None:
         self.metrics["checkpointing_rate"] += 1
         try:
             with self.token_context:
@@ -793,6 +813,30 @@ class StateMachineManager:
         except Exception as e:
             # Unserializable flow state is a programming error; fail loudly.
             raise FlowException(f"cannot checkpoint flow: {e}") from e
+
+    def flush_checkpoints(self) -> int:
+        """Serialize + write every round-dirty flow checkpoint (deferred
+        mode). Called by the node run loop inside the round transaction,
+        before the transport ACKs the round's inbound messages. One flow's
+        unserializable state must not abandon the other flows' writes: the
+        first error propagates AFTER every other dirty flow is flushed."""
+        if not self._dirty_checkpoints:
+            return 0
+        dirty, self._dirty_checkpoints = self._dirty_checkpoints, {}
+        n = 0
+        first_error: BaseException | None = None
+        for fsm in dirty.values():
+            if fsm.state == _DONE:
+                continue  # finished mid-round; checkpoint already removed
+            try:
+                self._write_checkpoint(fsm)
+                n += 1
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+        return n
 
     def _restore_checkpoints(self) -> None:
         """Rebuild flows by deterministic replay
@@ -864,8 +908,6 @@ class StateMachineManager:
         request: "VerifyTxRequest | VerifySigRequest",
     ) -> None:
         if not self._verify_queue:
-            import time as _time
-
             self._verify_waiting_since = _time.monotonic()
         self._verify_queue.append((fsm, request))
         if isinstance(request, VerifySigRequest):
@@ -1070,6 +1112,7 @@ class StateMachineManager:
 
     def _flow_finished(self, fsm: FlowStateMachine) -> None:
         self.flows.pop(fsm.run_id, None)
+        self._dirty_checkpoints.pop(fsm.run_id, None)
         self.checkpoint_storage.remove_checkpoint(fsm.run_id)
         self.metrics["finished"] += 1
         # Bounded outcome cache so RPC clients can fetch results after the

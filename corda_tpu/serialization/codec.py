@@ -62,6 +62,23 @@ _BY_NAME: dict[str, type] = {}
 _BY_TYPE: dict[type, str] = {}
 _CUSTOM_ENC: dict[type, Callable[[Any], tuple]] = {}
 _CUSTOM_DEC: dict[str, Callable[[tuple], Any]] = {}
+# type -> (encoded wire-name bytes, tuple of field names) — computed once per
+# class; dataclasses.fields() + str.encode() per encode call was the hottest
+# line in the notary-roundtrip profile.
+_ENC_PLAN: dict[type, tuple[bytes, tuple[str, ...]]] = {}
+# wire name -> (cls, ((field name, is_list_typed), ...)) for decode.
+_DEC_PLAN: dict[str, tuple[type, tuple[tuple[str, bool], ...]]] = {}
+# Immutable value types whose full encoding may be memoized on the instance
+# (attribute _codec_enc). Opt-in via mark_cacheable: the type must be deeply
+# immutable plain data (no service tokens), so the bytes stay valid for the
+# object's lifetime. SignedTransaction in a flow's checkpoint args was being
+# re-encoded on every suspension.
+_CACHEABLE: set[type] = set()
+
+
+def mark_cacheable(*classes: type) -> None:
+    """Enable instance-level encoding memoization for immutable value types."""
+    _CACHEABLE.update(classes)
 
 
 def register_class(
@@ -204,23 +221,40 @@ def _encode(out: bytearray, value: Any) -> None:
             _encode(out, value.token_name)
             return
         cls = type(value)
-        wire_name = _BY_TYPE.get(cls)
-        if wire_name is None:
-            raise TypeError(f"type {cls.__qualname__} is not registered for serialization")
+        cacheable = cls in _CACHEABLE
+        if cacheable:
+            cached = value.__dict__.get("_codec_enc")
+            if cached is not None:
+                out.extend(cached)
+                return
+        plan = _ENC_PLAN.get(cls)
+        if plan is None:
+            wire_name = _BY_TYPE.get(cls)
+            if wire_name is None:
+                raise TypeError(
+                    f"type {cls.__qualname__} is not registered for serialization")
+            name_raw = wire_name.encode("utf-8")
+            names = (() if cls in _CUSTOM_ENC else
+                     tuple(f.name for f in dataclasses.fields(cls)))
+            plan = _ENC_PLAN[cls] = (name_raw, names)
+        name_raw, names = plan
         enc = _CUSTOM_ENC.get(cls)
         if enc is not None:
             fields = tuple(enc(value))
         else:
-            fields = tuple(
-                getattr(value, f.name) for f in dataclasses.fields(value)
-            )
+            fields = tuple(getattr(value, n) for n in names)
+        start = len(out)
         out.append(_TAG_OBJECT)
-        raw = wire_name.encode("utf-8")
-        _write_varint(out, len(raw))
-        out.extend(raw)
+        _write_varint(out, len(name_raw))
+        out.extend(name_raw)
         _write_varint(out, len(fields))
         for f in fields:
             _encode(out, f)
+        if cacheable:
+            try:
+                object.__setattr__(value, "_codec_enc", bytes(out[start:]))
+            except AttributeError:
+                pass  # __slots__ types simply skip the memo
 
 
 _MAX_DEPTH = 64  # hostile nesting must exhaust this, not the Python stack
@@ -350,18 +384,24 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
             except Exception as e:  # malformed payloads must not crash callers
                 raise DeserializationError(
                     f"cannot decode {wire_name}: {e}") from e
-        flds = dataclasses.fields(cls)
-        if len(values) != len(flds):
+        plan = _DEC_PLAN.get(wire_name)
+        if plan is None:
+            plan = _DEC_PLAN[wire_name] = (cls, tuple(
+                (f.name, str(f.type).startswith(("list", "List")))
+                for f in dataclasses.fields(cls)))
+        _, field_plan = plan
+        if len(values) != len(field_plan):
             raise DeserializationError(
-                f"{wire_name}: expected {len(flds)} fields, got {len(values)}"
+                f"{wire_name}: expected {len(field_plan)} fields, "
+                f"got {len(values)}"
             )
         kwargs = {}
-        for f, v in zip(flds, values):
+        for (fname, is_list), v in zip(field_plan, values):
             # Tuples are the wire form of all sequences; convert back per the
             # declared field so list-typed fields round-trip.
-            if isinstance(v, tuple) and str(f.type).startswith(("list", "List")):
+            if is_list and isinstance(v, tuple):
                 v = list(v)
-            kwargs[f.name] = v
+            kwargs[fname] = v
         try:
             return cls(**kwargs), pos
         except Exception as e:  # malformed payloads must not crash callers

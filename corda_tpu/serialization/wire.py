@@ -21,7 +21,7 @@ from ..crypto.merkle import (
 from ..crypto.party import Party, PartyAndReference
 from ..crypto.signed_data import SignedData
 from ..utils.bytes import OpaqueBytes
-from .codec import SerializedBytes, register_class
+from .codec import SerializedBytes, mark_cacheable, register_class
 
 for _cls in (
     SecureHash,
@@ -43,3 +43,18 @@ for _cls in (
     PartialMerkleTree,
 ):
     register_class(_cls)
+
+# Deeply-immutable plain-data types on the checkpoint/message hot path:
+# their canonical encoding is memoized per instance (codec._CACHEABLE).
+mark_cacheable(
+    SecureHash,
+    SerializedBytes,
+    PublicKey,
+    DigitalSignature,
+    DigitalSignature.WithKey,
+    DigitalSignature.LegallyIdentifiable,
+    CompositeKeyLeaf,
+    CompositeKeyNode,
+    Party,
+    PartyAndReference,
+)

@@ -23,7 +23,7 @@ from ..crypto.composite import CompositeKey
 from ..crypto.hashes import SecureHash
 from ..crypto.keys import DigitalSignature, SignatureError, by_keys
 from ..crypto.provider import VerifyJob, get_verifier
-from ..serialization.codec import SerializedBytes, register
+from ..serialization.codec import SerializedBytes, mark_cacheable, register
 from .wire import WireTransaction
 
 
@@ -151,3 +151,9 @@ class SignedTransaction:
     def to_ledger_transaction(self, services):
         """verify_signatures + resolve dependencies (SignedTransaction.kt:131-137)."""
         return self.verify_signatures().to_ledger_transaction(services)
+
+
+# The checkpoint/wire hot object: a flow's SignedTransaction argument was
+# re-encoded at every suspension; the instance is deeply immutable, so its
+# canonical encoding is memoized (serialization/codec.py mark_cacheable).
+mark_cacheable(SignedTransaction)

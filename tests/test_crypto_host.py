@@ -209,3 +209,68 @@ class TestSignedData:
         tampered = SignedData(raw=serialize("evil payload"), sig=sig)
         with pytest.raises(SignatureError):
             tampered.verified()
+
+
+class TestFastEd25519Conformance:
+    """fast_ed25519 (OpenSSL accept / oracle-authoritative reject) must be
+    bit-identical to the ref_ed25519 oracle — including the S >= L accept
+    corner OpenSSL itself rejects."""
+
+    def test_sign_and_public_key_bit_identical(self):
+        import random
+
+        from corda_tpu.crypto import fast_ed25519 as fast
+        from corda_tpu.crypto import ref_ed25519 as ref
+
+        rng = random.Random(11)
+        for _ in range(8):
+            seed = bytes(rng.randrange(256) for _ in range(32))
+            msg = bytes(rng.randrange(256) for _ in range(rng.choice([0, 32])))
+            assert fast.sign(seed, msg) == ref.sign(seed, msg)
+            assert fast.public_key(seed) == ref.public_key(seed)
+
+    def test_verify_matches_oracle_on_adversarial_corpus(self):
+        import random
+
+        from corda_tpu.crypto import fast_ed25519 as fast
+        from corda_tpu.crypto import ref_ed25519 as ref
+
+        rng = random.Random(12)
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        pk = ref.public_key(seed)
+        msg = bytes(rng.randrange(256) for _ in range(32))
+        sig = ref.sign(seed, msg)
+        s_plus_l = int.from_bytes(sig[32:], "little") + ref.L
+        flipped = bytearray(sig)
+        flipped[7] ^= 1
+        cases = [
+            (pk, msg, sig),                    # valid
+            (pk, msg, bytes(flipped)),         # corrupt
+            (pk, b"x" * 32, sig),              # wrong message
+            (pk, msg, sig[:32] + s_plus_l.to_bytes(32, "little")),  # S+L
+            (b"\x00" * 32, msg, b"\x00" * 64),  # degenerate
+            (b"\xff" * 32, msg, sig),          # invalid point
+            (pk, msg, sig[:40]),               # short sig
+            (pk[:16], msg, sig),               # short key
+        ]
+        # non-canonical A encodings (y >= p) that still decompress
+        for yy in range(19):
+            enc = (yy + ref.P).to_bytes(32, "little")
+            if ref.decompress(enc) is not None:
+                cases.append((enc, msg, sig))
+        for pk_c, msg_c, sig_c in cases:
+            assert fast.verify(pk_c, msg_c, sig_c) == ref.verify(
+                pk_c, msg_c, sig_c)
+
+    def test_s_plus_l_accepted_via_fallback(self):
+        # The one known OpenSSL/oracle divergence: the fallback must accept.
+        from corda_tpu.crypto import fast_ed25519 as fast
+        from corda_tpu.crypto import ref_ed25519 as ref
+
+        seed = b"\x21" * 32
+        pk = ref.public_key(seed)
+        msg = b"m" * 32
+        sig = ref.sign(seed, msg)
+        s = int.from_bytes(sig[32:], "little") + ref.L
+        mangled = sig[:32] + s.to_bytes(32, "little")
+        assert fast.verify(pk, msg, mangled) is True

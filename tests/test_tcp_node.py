@@ -368,3 +368,140 @@ class TestHostileSocket:
             t.join(timeout=2)
             for n in nodes:
                 n.stop()
+
+
+class TestRoundTransactions:
+    """The run-loop round batch (NodeDatabase.batch + TcpMessaging round
+    deferral): a round commits as ONE unit, a failed round rolls back as one
+    unit, and the dedupe/ACK machinery follows the transaction's fate."""
+
+    def test_round_commits_as_unit(self, tmp_path):
+        from corda_tpu.node.services.persistence import NodeDatabase
+
+        db = NodeDatabase(tmp_path / "n.db")
+        with db.batch():
+            db.set_setting("a", "1")
+            db.set_setting("b", "2")
+            # Not yet visible to a second connection (uncommitted).
+            assert db.aux_conn.execute(
+                "SELECT COUNT(*) FROM settings WHERE key IN ('a','b')"
+            ).fetchone()[0] == 0
+        assert db.get_setting("a") == "1"
+        assert db.get_setting("b") == "2"
+        db.close()
+
+    def test_failed_round_rolls_back(self, tmp_path):
+        from corda_tpu.node.services.persistence import NodeDatabase
+
+        db = NodeDatabase(tmp_path / "n.db")
+        with pytest.raises(RuntimeError):
+            with db.batch():
+                db.set_setting("a", "1")
+                raise RuntimeError("mid-round failure")
+        assert db.get_setting("a") is None
+        # The connection stays usable for the next round.
+        with db.batch():
+            db.set_setting("a", "2")
+        assert db.get_setting("a") == "2"
+        db.close()
+
+    def test_foreign_thread_commit_is_immediate(self, tmp_path):
+        # A webserver-style thread must keep commit-before-return while the
+        # node thread holds a round open (db.lock serializes them).
+        import threading
+
+        from corda_tpu.node.services.persistence import (
+            DBAttachmentStorage,
+            NodeDatabase,
+        )
+
+        db = NodeDatabase(tmp_path / "n.db")
+        storage = DBAttachmentStorage(db)
+        in_round = threading.Event()
+        release = threading.Event()
+        result = {}
+
+        def node_round():
+            with db.batch():
+                db.set_setting("round", "open")
+                in_round.set()
+                release.wait(timeout=5.0)
+
+        def http_upload():
+            in_round.wait(timeout=5.0)
+            att_id = storage.import_attachment(b"payload")
+            # By the time import_attachment returns, the row must be durable
+            # (visible to an independent connection).
+            result["count"] = db.aux_conn.execute(
+                "SELECT COUNT(*) FROM attachments WHERE att_id = ?",
+                (att_id.bytes,)).fetchone()[0]
+
+        t1 = threading.Thread(target=node_round)
+        t2 = threading.Thread(target=http_upload)
+        t1.start()
+        t2.start()
+        # The upload blocks on db.lock until the round ends.
+        release.set()
+        t1.join(timeout=10.0)
+        t2.join(timeout=10.0)
+        assert result.get("count") == 1
+        db.close()
+
+    def test_dedupe_mirror_follows_round_fate(self, tmp_path):
+        from corda_tpu.node.messaging.tcp import _Dedupe
+        from corda_tpu.node.services.persistence import NodeDatabase
+
+        db = NodeDatabase(tmp_path / "n.db")
+        dedupe = _Dedupe(db)
+        # Aborted round: the mirror entry must unwind with the rollback so a
+        # redelivery is processed, not swallowed.
+        try:
+            with db.batch():
+                dedupe.record(b"lost-message")
+                raise RuntimeError("round failed")
+        except RuntimeError:
+            pass
+        dedupe.round_aborted()
+        assert not dedupe.seen(b"lost-message")
+        # Committed round: the entry stays.
+        with db.batch():
+            dedupe.record(b"kept-message")
+        dedupe.round_committed()
+        assert dedupe.seen(b"kept-message")
+        db.close()
+
+    def test_flush_checkpoints_isolates_bad_flow(self):
+        from corda_tpu.flows.api import FlowException
+        from corda_tpu.node.statemachine import (
+            InMemoryCheckpointStorage,
+            StateMachineManager,
+        )
+
+        class _Good:
+            state = "runnable"
+            run_id = b"good"
+
+        class _Bad:
+            state = "runnable"
+            run_id = b"bad"
+
+        storage = InMemoryCheckpointStorage()
+        smm = StateMachineManager.__new__(StateMachineManager)
+        smm.defer_checkpoints = True
+        smm.checkpoint_storage = storage
+        smm.metrics = {"checkpointing_rate": 0}
+        written = []
+
+        def write(fsm):
+            if fsm.run_id == b"bad":
+                raise FlowException("unserializable flow state")
+            written.append(fsm.run_id)
+
+        smm._write_checkpoint = write
+        smm._dirty_checkpoints = {b"bad": _Bad(), b"good": _Good()}
+        with pytest.raises(FlowException):
+            smm.flush_checkpoints()
+        # The good flow's checkpoint was still written, and the dirty set is
+        # drained (no stale resurrection of the failed flow's entry).
+        assert written == [b"good"]
+        assert smm._dirty_checkpoints == {}
