@@ -375,6 +375,12 @@ class Node:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import faulthandler
+    import signal
+
+    # Operator diagnostics: `kill -USR1 <pid>` dumps every thread's stack to
+    # stderr (the node.log) — the moral equivalent of a JVM thread dump.
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     argv = argv if argv is not None else sys.argv[1:]
     if len(argv) != 1:
         print("usage: python -m corda_tpu.node.node <config.toml>",

@@ -27,6 +27,8 @@ and must be stable across processes, hosts and framework versions. Design:
     0x08 object      registered type name (str payload) + varint field count
                      + field values in dataclass field order
     0x09 frozenset   varint count + items sorted by their encodings
+    0x0A float       8-byte IEEE-754 big-endian; finite only, -0.0
+                     normalized to 0.0 (one encoding per equal value)
 
 Dataclasses register with `@register` (or `register_class`); the registry maps
 a stable wire name to the class. Deserializing an unregistered name raises
@@ -52,6 +54,7 @@ _TAG_LIST = 0x06
 _TAG_DICT = 0x07
 _TAG_OBJECT = 0x08
 _TAG_FROZENSET = 0x09
+_TAG_FLOAT = 0x0A
 
 
 class DeserializationError(Exception):
@@ -161,6 +164,16 @@ def _encode(out: bytearray, value: Any) -> None:
     elif isinstance(value, int):
         out.append(_TAG_INT)
         _write_varint(out, _zigzag(value))
+    elif isinstance(value, float):
+        import math
+        import struct as _struct
+
+        if not math.isfinite(value):
+            raise TypeError("non-finite floats are not serializable")
+        if value == 0.0:
+            value = 0.0  # normalize -0.0: equal values, one encoding
+        out.append(_TAG_FLOAT)
+        out.extend(_struct.pack(">d", value))
     elif isinstance(value, bytes):
         out.append(_TAG_BYTES)
         _write_varint(out, len(value))
@@ -276,6 +289,18 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
     if tag == _TAG_INT:
         n, pos = _read_varint(data, pos)
         return _unzigzag(n), pos
+    if tag == _TAG_FLOAT:
+        import math
+        import struct as _struct
+
+        if pos + 8 > len(data):
+            raise DeserializationError("truncated float")
+        (value,) = _struct.unpack(">d", data[pos:pos + 8])
+        if not math.isfinite(value):
+            raise DeserializationError("non-finite float")
+        if value == 0.0 and data[pos] != 0:
+            raise DeserializationError("non-canonical negative zero")
+        return value, pos + 8
     if tag == _TAG_BYTES:
         n, pos = _read_varint(data, pos)
         if pos + n > len(data):

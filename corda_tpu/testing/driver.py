@@ -49,23 +49,37 @@ class NodeProcess:
     address: tuple[str, int] | None = None
     rpc_users: list = field(default_factory=list)
 
+    @property
+    def log_path(self) -> Path:
+        return self.base_dir / "node.log"
+
     def wait_up(self, timeout: float = 60.0) -> "NodeProcess":
-        """Block until the node prints its startup banner; parse the port."""
+        """Block until the node logs its startup banner; parse the port.
+        Output goes to base_dir/node.log (NOT a pipe: an undrained pipe
+        would eventually block the node on a full buffer, and the log
+        survives for post-mortem)."""
         deadline = time.monotonic() + timeout
-        assert self.process.stdout is not None
+        prefix = f"node {self.name} up at "
         while time.monotonic() < deadline:
             if self.process.poll() is not None:
+                tail = ""
+                try:
+                    tail = self.log_path.read_text(errors="replace")[-2000:]
+                except OSError:
+                    pass
                 raise RuntimeError(
-                    f"node {self.name} exited with {self.process.returncode}")
-            line = self.process.stdout.readline()
-            if not line:
-                time.sleep(0.02)
-                continue
-            text = line.decode(errors="replace").strip()
-            if text.startswith(f"node {self.name} up at "):
-                host, port = text.rsplit(" ", 1)[-1].rsplit(":", 1)
-                self.address = (host, int(port))
-                return self
+                    f"node {self.name} exited with {self.process.returncode}:"
+                    f"\n{tail}")
+            try:
+                text = self.log_path.read_text(errors="replace")
+            except OSError:
+                text = ""
+            for line in text.splitlines():
+                if line.startswith(prefix):
+                    host, port = line.rsplit(" ", 1)[-1].rsplit(":", 1)
+                    self.address = (host, int(port))
+                    return self
+            time.sleep(0.02)
         raise TimeoutError(f"node {self.name} did not come up in {timeout}s")
 
     def rpc(self, user: str, password: str, timeout: float = 20.0):
@@ -80,6 +94,19 @@ class NodeProcess:
         """SIGKILL — the Disruption.kt:18-60 'kill the process' primitive."""
         self.process.kill()
         self.process.wait(timeout=10)
+
+    def sigstop(self) -> None:
+        """SIGSTOP — the 'hang' primitive (Disruption.kt strainer): the
+        process is frozen, not dead; peers see an unresponsive node whose
+        sockets stay open — a different failure mode than a clean kill."""
+        import signal
+
+        self.process.send_signal(signal.SIGSTOP)
+
+    def sigcont(self) -> None:
+        import signal
+
+        self.process.send_signal(signal.SIGCONT)
 
     def terminate(self) -> None:
         self.process.terminate()
@@ -131,10 +158,12 @@ class Driver:
 
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")  # node processes don't need TPU
+        log = open(node_dir / "node.log", "ab")
         process = subprocess.Popen(
             [sys.executable, "-m", "corda_tpu.node.node", str(config_path)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            stdout=log, stderr=subprocess.STDOUT,
             cwd="/root/repo", env=env)
+        log.close()  # the child owns the fd now
         handle = NodeProcess(name, node_dir, config_path, process,
                              rpc_users=rpc_users)
         self.nodes.append(handle)
@@ -142,9 +171,33 @@ class Driver:
             handle.wait_up()
         return handle
 
+    def restart_node(self, handle: NodeProcess,
+                     wait: bool = True) -> NodeProcess:
+        """Re-spawn a (killed) node over its existing base_dir + config —
+        rebirth purely from disk (the kill/restart Disruption primitive)."""
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(handle.base_dir / "node.log", "ab")
+        process = subprocess.Popen(
+            [sys.executable, "-m", "corda_tpu.node.node",
+             str(handle.config_path)],
+            stdout=log, stderr=subprocess.STDOUT,
+            cwd="/root/repo", env=env)
+        log.close()
+        reborn = NodeProcess(handle.name, handle.base_dir, handle.config_path,
+                             process, rpc_users=handle.rpc_users)
+        self.nodes.append(reborn)
+        if wait:
+            reborn.wait_up()
+        return reborn
+
     def stop_all(self) -> None:
         for node in self.nodes:
             if node.process.poll() is None:
+                try:
+                    node.sigcont()  # un-freeze SIGSTOP'd nodes so they exit
+                except (OSError, ValueError):
+                    pass
                 node.terminate()
 
 

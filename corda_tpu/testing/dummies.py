@@ -70,6 +70,34 @@ class DummyContract(Contract):
         tx.add_output_state(new_state)
         return tx
 
+    @staticmethod
+    def generate_initial_multi(
+        owners: tuple[CompositeKey, ...], magic_number: int, notary: Party
+    ) -> TransactionBuilder:
+        """Issue a multi-owner state (DummyContract.kt MultiOwnerState): a
+        move of it needs a signature from EVERY owner — the fan-out-verify
+        workload shape (BASELINE config 4; NotaryDemo firehose widened)."""
+        state = DummyMultiOwnerState(magic_number, tuple(owners))
+        tx = TransactionBuilder(notary=notary)
+        tx.add_output_state(state)
+        tx.add_command(Command(DummyCreate(), tuple(owners)))
+        return tx
+
+    @staticmethod
+    def move_multi(prior: StateAndRef,
+                   new_owners: tuple[CompositeKey, ...]) -> TransactionBuilder:
+        """Move a multi-owner state; signers = every current owner, so the
+        transaction carries len(owners) signatures through the verify pump."""
+        prior_state = prior.state.data
+        if not isinstance(prior_state, DummyMultiOwnerState):
+            raise ValueError("move_multi needs a DummyMultiOwnerState input")
+        tx = TransactionBuilder(notary=prior.state.notary)
+        tx.add_input_state(prior)
+        tx.add_command(Command(DummyMove(), tuple(prior_state.owners)))
+        tx.add_output_state(DummyMultiOwnerState(
+            prior_state.magic_number, tuple(new_owners)))
+        return tx
+
 
 DUMMY_PROGRAM_ID = DummyContract()
 

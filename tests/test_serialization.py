@@ -240,3 +240,40 @@ def test_decoder_rejects_unhashable_keys_and_members():
         deserialize(bytes([0x07, 0x01, 0x07, 0x00, 0x00]))  # dict key = dict
     with pytest.raises(DeserializationError, match="unhashable set member"):
         deserialize(bytes([0x09, 0x01, 0x07, 0x00]))  # set member = dict
+
+
+class TestFloatCodec:
+    """Float tag (0x0A): canonical 8-byte IEEE-754, finite only."""
+
+    def test_roundtrip(self):
+        for v in (0.0, 1.5, -2.25, 1e-300, 3.141592653589793, 180.4):
+            assert deserialize(serialize(v).bytes) == v
+
+    def test_negative_zero_normalized(self):
+        assert serialize(-0.0).bytes == serialize(0.0).bytes
+
+    def test_non_finite_rejected_on_encode(self):
+        import math
+
+        for v in (math.inf, -math.inf, math.nan):
+            with pytest.raises(TypeError):
+                serialize(v)
+
+    def test_non_finite_rejected_on_decode(self):
+        import struct
+
+        for raw in (struct.pack(">d", 7.5)[:4],):  # truncated
+            with pytest.raises(DeserializationError):
+                deserialize(b"\x0a" + raw)
+        inf_bits = struct.pack(">d", 1.0).replace(
+            b"\x3f\xf0", b"\x7f\xf0", 1)
+        with pytest.raises(DeserializationError):
+            deserialize(b"\x0a" + inf_bits)
+        neg_zero = (0x8000000000000000).to_bytes(8, "big")
+        with pytest.raises(DeserializationError):
+            deserialize(b"\x0a" + neg_zero)
+
+    def test_distinct_from_int(self):
+        assert deserialize(serialize(1.0).bytes) == 1.0
+        assert isinstance(deserialize(serialize(1.0).bytes), float)
+        assert isinstance(deserialize(serialize(1).bytes), int)
