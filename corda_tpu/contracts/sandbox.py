@@ -80,7 +80,7 @@ ALLOWED_BUILTINS = frozenset({
     "abs", "all", "any", "bin", "bool", "bytearray", "bytes", "callable",
     "chr", "classmethod", "dict", "divmod", "enumerate", "filter", "float",
     "format",
-    "frozenset", "hex", "int", "isinstance",
+    "frozenset", "hex", "int", "isinstance", "NotImplemented",
     "issubclass", "iter", "len", "list", "map", "max", "min", "next",
     "object", "oct", "ord", "pow", "property", "range", "repr", "reversed",
     "round", "set", "slice", "sorted", "staticmethod", "str", "sum", "super",
@@ -131,6 +131,19 @@ FORBIDDEN_ATTRS = frozenset({
     "format", "format_map", "vformat",
 })
 
+# Names that define WHERE code claims to come from. Assigning them (module
+# body `__name__ = "math"`, class body `__module__ = "math"`) would let
+# hostile code impersonate a whitelisted module and borrow its trust, so the
+# vetter rejects the stores. One emission is excused: every class body
+# implicitly runs `__module__ = __name__` (LOAD_NAME __name__ directly
+# before the store) — harmless, because __name__ itself cannot be forged.
+# (__doc__ / __all__ / __qualname__ stay assignable — no trust decision
+# reads them.)
+_IDENTITY_NAMES = frozenset({
+    "__name__", "__module__", "__package__",
+    "__builtins__", "__loader__", "__spec__", "__class__",
+})
+
 # Exception types are fine to reference (contracts raise to reject).
 _EXCEPTION_NAMES = frozenset(
     n for n in dir(builtins)
@@ -140,6 +153,25 @@ _EXCEPTION_NAMES = frozenset(
 
 def _module_allowed(name: str, whitelist: tuple[str, ...]) -> bool:
     return any(name == w or name.startswith(w + ".") for w in whitelist)
+
+
+def _is_dataclass_hash(cls: type, attr) -> bool:
+    """True only for the __hash__ dataclasses generates for frozen/eq
+    classes: defined on a dataclass, compiled from the '<string>' source
+    dataclasses uses, reaching nothing but the hash() builtin and the
+    class's own field names, and carrying no constants. Anything else —
+    including a hand-written hash smuggling code — gets vetted normally.
+    (Forging this shape needs compile()/exec(), which module vetting bans.)
+    """
+    code = getattr(attr, "__code__", None)
+    return (isinstance(attr, types.FunctionType)
+            and code is not None
+            and "__dataclass_fields__" in vars(cls)
+            and code.co_filename == "<string>"
+            and not code.co_freevars
+            and set(code.co_consts) <= {None}
+            and set(code.co_names)
+            <= {"hash"} | set(cls.__dataclass_fields__))
 
 
 class DeterministicSandbox:
@@ -173,8 +205,7 @@ class DeterministicSandbox:
         the trust root, exactly as the reference's classloader trusts the
         JDK/platform jars it doesn't rewrite)."""
         fn = getattr(fn, "__func__", fn)
-        if _module_allowed(getattr(fn, "__module__", None) or "",
-                           self.module_whitelist):
+        if self._trusted_home(fn):
             return
         code = getattr(fn, "__code__", None)
         if code is None:
@@ -186,6 +217,33 @@ class DeterministicSandbox:
             except ValueError:
                 pass  # unbound cell; resolves to NameError at runtime
         self._vet_code(code, getattr(fn, "__globals__", {}), closure)
+
+    def _trusted_home(self, fn) -> bool:
+        """Is `fn` genuinely defined in a whitelisted module? Both name
+        sources a function carries — __module__ (which functools.wraps
+        copies from the wrapped function) and __globals__['__name__'] —
+        are just strings that hostile module-level code could forge before
+        vetting ever runs. So a name alone is NOT trusted: the function's
+        __globals__ must BE the claimed module's real namespace
+        (sys.modules identity). Forging that requires replacing a
+        sys.modules entry, which needs `sys` (not whitelisted) or
+        setattr/STORE_ATTR (both vetted away). The __module__ leg accepts
+        e.g. platform functions; the __globals__ leg accepts whitelisted-
+        module wrappers whose __module__ was overwritten by wraps (e.g.
+        dataclasses' _recursive_repr around a generated __repr__)."""
+        import sys
+
+        globs = getattr(fn, "__globals__", None)
+        names = (getattr(fn, "__module__", None),
+                 (globs or {}).get("__name__"))
+        for name in names:
+            if not isinstance(name, str) or not _module_allowed(
+                    name, self.module_whitelist):
+                continue
+            mod = sys.modules.get(name)
+            if mod is not None and getattr(mod, "__dict__", None) is globs:
+                return True
+        return False
 
     def _vet_code(self, code: types.CodeType, globs: dict,
                   closure: dict | None = None) -> None:
@@ -205,6 +263,7 @@ class DeterministicSandbox:
                         closure: dict | None = None) -> None:
         where = f"{code.co_filename}:{code.co_name}"
 
+        prev = None
         for inst in dis.get_instructions(code):
             if inst.opname in ("IMPORT_NAME", "IMPORT_FROM"):
                 mod = str(inst.argval)
@@ -226,16 +285,47 @@ class DeterministicSandbox:
                 # Persistent module-level state makes replays diverge.
                 raise SandboxViolation(
                     f"{where}: mutation of global {inst.argval!r}")
+            elif inst.opname in ("STORE_NAME", "DELETE_NAME") \
+                    and str(inst.argval) in _IDENTITY_NAMES:
+                implicit_class_module = (
+                    inst.argval == "__module__" and prev is not None
+                    and prev.opname == "LOAD_NAME"
+                    and prev.argval == "__name__")
+                if not implicit_class_module:
+                    raise SandboxViolation(
+                        f"{where}: assignment to identity name "
+                        f"{inst.argval!r}")
             elif inst.opname in ("STORE_ATTR", "DELETE_ATTR"):
                 # Contracts must treat the tx view (and anything reachable
                 # from it, including platform modules) as immutable.
                 raise SandboxViolation(
                     f"{where}: attribute mutation {inst.argval!r}")
+            prev = inst
 
+        # The docstring slot (co_consts[0] of a non-lambda code object) is
+        # exempt from the dunder scan below: docs and error text legitimately
+        # *mention* names like __dict__, and this scan is evadable
+        # defense-in-depth anyway — precision beats breadth here (round-3
+        # advisor). But co_consts[0] is only a docstring if the code never
+        # USES it as data: in `X = "__globals__"` (no docstring) the string
+        # lands in slot 0 too, so exempt it only when it is never loaded, or
+        # loaded solely to be stored as __doc__ (the module-body pattern).
+        doc = None
+        if (code.co_consts and isinstance(code.co_consts[0], str)
+                and code.co_name != "<lambda>"):
+            doc = code.co_consts[0]
+            insts = list(dis.get_instructions(code))
+            for i, ins in enumerate(insts):
+                if ins.opname == "LOAD_CONST" and ins.argval is doc:
+                    nxt = insts[i + 1] if i + 1 < len(insts) else None
+                    if not (nxt is not None and nxt.opname == "STORE_NAME"
+                            and nxt.argval == "__doc__"):
+                        doc = None  # slot 0 is data, not a docstring
+                        break
         for const in code.co_consts:
             if isinstance(const, types.CodeType):
                 self._vet_code(const, globs)
-            elif isinstance(const, str):
+            elif isinstance(const, str) and const is not doc:
                 # Reflection attribute names smuggled as *data* — e.g. a
                 # string handed to a platform helper that does attribute
                 # lookup. Defense in depth only: a string assembled at
@@ -317,8 +407,16 @@ class DeterministicSandbox:
                         "__static_attributes__", "__slots__",
                         "__annotations__", "__match_args__",
                         "__dataclass_fields__", "__dataclass_params__",
-                        "__parameters__", "__orig_bases__", "__hash__",
+                        "__parameters__", "__orig_bases__",
                         "__abstractmethods__", "_abc_impl"):
+                continue
+            # __hash__ is vetted like any method (round-3 advisor: a blanket
+            # skip let a user-defined __hash__ run unvetted — a full escape
+            # the moment an instance lands in a set). The ONE shape excused
+            # is the dataclass-generated hash, which calls the otherwise-
+            # forbidden hash() builtin; it is recognised by provenance and
+            # body shape, not by name.
+            if name == "__hash__" and _is_dataclass_hash(cls, attr):
                 continue
             attr = getattr(attr, "__func__", attr)  # class/staticmethod
             if isinstance(attr, property):

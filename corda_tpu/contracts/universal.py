@@ -356,10 +356,26 @@ def _flat_parts(arr: Arrangement) -> list:
     Zeros dropped). Used for multiset output comparison in UAction."""
     if isinstance(arr, All):
         parts: list = []
-        for a in arr.arrangements:  # callers sort the flattened result
+        for a in arr.arrangements:
             parts.extend(_flat_parts(a))
         return parts
     return [] if arr == ZERO else [arr]
+
+
+def _multiset_equal(produced: list, expected: list) -> bool:
+    """==-based multiset equality: for each expected part find and remove
+    one equal produced part. Quadratic, but action results are a handful of
+    parts; crucially it depends only on Arrangement.__eq__, never on repr
+    ordering of frozenset fields."""
+    remaining = list(produced)
+    for part in expected:
+        for i, cand in enumerate(remaining):
+            if cand == part:
+                del remaining[i]
+                break
+        else:
+            return False
+    return not remaining
 
 
 # ---------------------------------------------------------------------------
@@ -788,19 +804,22 @@ class UniversalContract(Contract):
             # parts, not via all_of: All's frozenset collapses duplicates, so
             # outputs [X, Y, Y] would compare equal to All{X, Y} and an
             # authorized actor could mint duplicate obligation states
-            # (round-2 advisor finding). Element-for-element on sorted part
-            # lists makes duplication visible.
+            # (round-2 advisor finding). ==-based find-and-remove matching,
+            # NOT sorted(key=repr): equal arrangements holding frozenset
+            # fields can repr in different element orders, and a repr-keyed
+            # sort would then misalign equal multisets and nondeterministically
+            # reject valid transactions across nodes (round-3 advisor
+            # finding — a consensus hazard on the notary path).
             out_details = []
             for o in tx.outputs:
                 if not isinstance(o, UniversalState):
                     raise ValueError("output state is not a UniversalState")
                 out_details.append(o.details)
-            expected = sorted(_flat_parts(result), key=repr)
-            produced = sorted(
-                (p for d in out_details for p in _flat_parts(d)), key=repr)
+            expected = list(_flat_parts(result))
+            produced = [p for d in out_details for p in _flat_parts(d)]
             with require_that() as req:
                 req("output states must match action result state "
-                    "part-for-part", produced == expected)
+                    "part-for-part", _multiset_equal(produced, expected))
 
         elif isinstance(value, UApplyFixes):
             in_state = self._single_state(tx.inputs, "input")

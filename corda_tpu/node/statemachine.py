@@ -741,6 +741,9 @@ class StateMachineManager:
         self._service_queue: list[tuple[FlowStateMachine, Callable]] = []
         self.recent_results: dict[bytes, FlowFuture] = {}
         self._pumping = False
+        # Optional on-demand network-map refresh (set by the node assembly):
+        # consulted once when a send target is missing from the cache.
+        self.netmap_refresh: Callable[[], None] | None = None
         self.changes = EventLog()  # bounded flow/progress event feed
         # Metrics (reference: StateMachineManager.kt:105-113)
         self.metrics = {"started": 0, "finished": 0, "checkpointing_rate": 0,
@@ -806,10 +809,13 @@ class StateMachineManager:
 
     def _write_checkpoint(self, fsm: FlowStateMachine) -> None:
         self.metrics["checkpointing_rate"] += 1
+        blob = self._serialize_checkpoint(fsm)
+        self.checkpoint_storage.update_checkpoint(fsm.run_id, blob)
+
+    def _serialize_checkpoint(self, fsm: FlowStateMachine) -> bytes:
         try:
             with self.token_context:
-                blob = serialize(fsm.to_checkpoint()).bytes
-            self.checkpoint_storage.update_checkpoint(fsm.run_id, blob)
+                return serialize(fsm.to_checkpoint()).bytes
         except Exception as e:
             # Unserializable flow state is a programming error; fail loudly.
             raise FlowException(f"cannot checkpoint flow: {e}") from e
@@ -817,25 +823,35 @@ class StateMachineManager:
     def flush_checkpoints(self) -> int:
         """Serialize + write every round-dirty flow checkpoint (deferred
         mode). Called by the node run loop inside the round transaction,
-        before the transport ACKs the round's inbound messages. One flow's
-        unserializable state must not abandon the other flows' writes: the
-        first error propagates AFTER every other dirty flow is flushed."""
+        before the transport ACKs the round's inbound messages.
+
+        A flow whose state will not SERIALIZE is failed in place — exactly
+        what an exception raised inside one of its handlers would do — and
+        the round stays committable. Propagating instead would roll back the
+        whole round and exit the node; on restart the flow would replay to
+        the same unserializable state and crash it again — a permanent
+        crash loop triggered by one bad flow (round-3 advisor finding).
+        Storage-level write failures still abort the round: those compromise
+        every flow's durability, not one flow's.
+        """
         if not self._dirty_checkpoints:
             return 0
         dirty, self._dirty_checkpoints = self._dirty_checkpoints, {}
         n = 0
-        first_error: BaseException | None = None
         for fsm in dirty.values():
             if fsm.state == _DONE:
                 continue  # finished mid-round; checkpoint already removed
+            self.metrics["checkpointing_rate"] += 1
             try:
-                self._write_checkpoint(fsm)
-                n += 1
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                if first_error is None:
-                    first_error = e
-        if first_error is not None:
-            raise first_error
+                blob = self._serialize_checkpoint(fsm)
+            except FlowException as e:
+                logger.error(
+                    "flow %s has unserializable state; failing the flow: %s",
+                    fsm.run_id.hex()[:8], e)
+                fsm._fail(e)
+                continue
+            self.checkpoint_storage.update_checkpoint(fsm.run_id, blob)
+            n += 1
         return n
 
     def _restore_checkpoints(self) -> None:
@@ -1030,6 +1046,16 @@ class StateMachineManager:
 
     def _send_session_message(self, party: Party, session_id: int, payload) -> None:
         node = self.service_hub.network_map_cache.get_node_by_legal_identity(party)
+        if node is None and self.netmap_refresh is not None:
+            # A peer we've never heard of usually means OUR cache is stale,
+            # not that the peer doesn't exist (e.g. a client that registered
+            # after our last refresh sends us a SessionInit; the reply
+            # address is missing). Refresh on demand and retry once before
+            # failing — otherwise the reply is lost and the initiator stalls
+            # in redelivery backoff until the periodic refresh catches up.
+            self.netmap_refresh()
+            node = self.service_hub.network_map_cache \
+                .get_node_by_legal_identity(party)
         if node is None:
             raise FlowException(f"don't know where to send to {party}")
         self.messaging.send(

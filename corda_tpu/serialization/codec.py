@@ -236,7 +236,10 @@ def _encode(out: bytearray, value: Any) -> None:
         cls = type(value)
         cacheable = cls in _CACHEABLE
         if cacheable:
-            cached = value.__dict__.get("_codec_enc")
+            # getattr, not value.__dict__: a __slots__ class has no instance
+            # dict and must skip the memo on the read side too (the write
+            # side already guards; round-3 advisor).
+            cached = getattr(value, "_codec_enc", None)
             if cached is not None:
                 out.extend(cached)
                 return

@@ -328,3 +328,83 @@ class TestCostAccounting:
             tx.fails_with("issuer")
         with pytest.raises(Exception, match="issuer"):
             sandboxed_verify(bad)
+
+
+class TestHashVetting:
+    def test_user_defined_hash_is_vetted(self):
+        # Round-3 advisor: __hash__ sat on the vet skip list, so a hostile
+        # __hash__ ran arbitrary unvetted code the moment an instance
+        # landed in a set.
+        class Sneaky:
+            def __hash__(self):
+                open("/etc/passwd")
+                return 0
+
+        class EvilContract(Contract):
+            def verify(self, tx):
+                return len({Sneaky()})
+
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+    def test_frozen_dataclass_state_passes(self):
+        # The ONE excused __hash__ shape: the dataclass-generated hash
+        # (calls the otherwise-forbidden hash() builtin). Its provenance +
+        # body shape are checked, not its name.
+        from dataclasses import dataclass
+
+        @dataclass(frozen=True)
+        class Pt:
+            x: int
+
+        class GoodContract(Contract):
+            def verify(self, tx):
+                return len({Pt(1), Pt(2)})
+
+        DeterministicSandbox().vet_contract(GoodContract())  # must not raise
+
+    def test_docstring_mentioning_dunder_passes(self):
+        # Round-3 advisor (low): docs/error text legitimately *mention*
+        # reflection names; only non-docstring string constants scan.
+        class DocContract(Contract):
+            def verify(self, tx):
+                "a contract may not touch __dict__ here"
+                return True
+
+        DeterministicSandbox().vet_contract(DocContract())  # must not raise
+
+    def test_non_docstring_constant_still_rejected(self):
+        class EvilContract(Contract):
+            def verify(self, tx):
+                "legit docstring"
+                return "x.__globals__"
+
+        with pytest.raises(SandboxViolation, match="string constant"):
+            DeterministicSandbox().vet_contract(EvilContract())
+
+
+class TestTrustForgery:
+    def test_forged_module_name_does_not_borrow_trust(self):
+        # code-review finding: __module__ / __globals__['__name__'] are just
+        # strings a hostile module body could forge before vetting runs.
+        # Trust requires the function's __globals__ to BE the claimed
+        # module's real sys.modules namespace.
+        ns = {"__name__": "math"}
+        exec("def verify(self, tx):\n    return open('/etc/passwd')", ns)
+        evil_verify = ns["verify"]
+        assert evil_verify.__module__ == "math"  # the forgery "took"
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox().vet(evil_verify)
+
+    def test_identity_name_assignment_rejected_in_module_body(self):
+        # The loader vets module bodies pre-exec; assigning __name__ there
+        # is the impersonation primitive and must fail vetting.
+        code = compile('__name__ = "math"\nx = 1\n', "<attachment>", "exec")
+        with pytest.raises(SandboxViolation, match="identity name"):
+            DeterministicSandbox()._vet_code(code, {})
+
+    def test_class_body_module_assignment_rejected(self):
+        code = compile(
+            'class C:\n    __module__ = "math"\n', "<attachment>", "exec")
+        with pytest.raises(SandboxViolation, match="identity name"):
+            DeterministicSandbox()._vet_code(code, {})

@@ -470,12 +470,19 @@ class TestRoundTransactions:
         assert dedupe.seen(b"kept-message")
         db.close()
 
-    def test_flush_checkpoints_isolates_bad_flow(self):
+    def test_flush_checkpoints_fails_bad_flow_in_place(self):
+        # Round-3 advisor: propagating a serialization error out of
+        # flush_checkpoints rolled back the WHOLE round and exited the node;
+        # restart replayed the flow to the same unserializable state — a
+        # permanent crash loop. The bad flow must instead be failed like a
+        # handler exception would fail it, keeping the round committable.
         from corda_tpu.flows.api import FlowException
         from corda_tpu.node.statemachine import (
             InMemoryCheckpointStorage,
             StateMachineManager,
         )
+
+        failed = []
 
         class _Good:
             state = "runnable"
@@ -485,23 +492,47 @@ class TestRoundTransactions:
             state = "runnable"
             run_id = b"bad"
 
+            def _fail(self, exc):
+                self.state = "done"
+                failed.append(exc)
+
         storage = InMemoryCheckpointStorage()
         smm = StateMachineManager.__new__(StateMachineManager)
         smm.defer_checkpoints = True
         smm.checkpoint_storage = storage
         smm.metrics = {"checkpointing_rate": 0}
-        written = []
 
-        def write(fsm):
+        def ser(fsm):
             if fsm.run_id == b"bad":
                 raise FlowException("unserializable flow state")
-            written.append(fsm.run_id)
+            return b"blob-" + fsm.run_id
 
-        smm._write_checkpoint = write
+        smm._serialize_checkpoint = ser
         smm._dirty_checkpoints = {b"bad": _Bad(), b"good": _Good()}
-        with pytest.raises(FlowException):
-            smm.flush_checkpoints()
-        # The good flow's checkpoint was still written, and the dirty set is
-        # drained (no stale resurrection of the failed flow's entry).
-        assert written == [b"good"]
+        assert smm.flush_checkpoints() == 1
+        assert [type(e) for e in failed] == [FlowException]
+        # The good flow's checkpoint was written; the dirty set is drained.
+        assert list(storage.checkpoints()) == [b"blob-good"]
         assert smm._dirty_checkpoints == {}
+
+    def test_flush_checkpoints_storage_error_still_aborts_round(self):
+        # A STORAGE write failure compromises every flow's durability, not
+        # one flow's state: it must propagate and abort the round.
+        from corda_tpu.node.statemachine import StateMachineManager
+
+        class _Flow:
+            state = "runnable"
+            run_id = b"f"
+
+        class _BrokenStorage:
+            def update_checkpoint(self, run_id, blob):
+                raise OSError("disk full")
+
+        smm = StateMachineManager.__new__(StateMachineManager)
+        smm.defer_checkpoints = True
+        smm.checkpoint_storage = _BrokenStorage()
+        smm.metrics = {"checkpointing_rate": 0}
+        smm._serialize_checkpoint = lambda fsm: b"blob"
+        smm._dirty_checkpoints = {b"f": _Flow()}
+        with pytest.raises(OSError):
+            smm.flush_checkpoints()

@@ -522,3 +522,30 @@ class TestRollOut:
             tx.command(UAction("pay"), ACME.owning_key)
             tx.timestamp(day_ts(end_day))
             tx.verifies()
+
+
+def test_multiset_equal_is_order_and_repr_independent():
+    # Round-3 advisor: sorted(key=repr) misaligned equal multisets when
+    # equal Arrangement values holding frozenset fields repr'd their
+    # elements in different orders. The matcher must use only __eq__.
+    from corda_tpu.contracts.universal import _multiset_equal
+
+    class OrderlessRepr:
+        """Equal values that repr differently (models frozenset fields)."""
+
+        def __init__(self, key, salt):
+            self.key = key
+            self.salt = salt
+
+        def __eq__(self, other):
+            return isinstance(other, OrderlessRepr) and self.key == other.key
+
+        def __repr__(self):  # pragma: no cover - diagnostic only
+            return f"OrderlessRepr({self.salt!r})"
+
+    a1, a2 = OrderlessRepr("a", "x"), OrderlessRepr("a", "y")
+    b = OrderlessRepr("b", "z")
+    assert _multiset_equal([a1, b], [b, a2])      # order + repr independent
+    assert not _multiset_equal([a1, a2, b], [a1, b])   # duplicate minted
+    assert not _multiset_equal([a1], [a1, b])          # part missing
+    assert _multiset_equal([], [])
