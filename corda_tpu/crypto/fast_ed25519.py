@@ -40,6 +40,22 @@ try:  # pragma: no cover - exercised implicitly by every test run
     _AVAILABLE = True
 except Exception:  # pragma: no cover
     _AVAILABLE = False
+    import warnings
+
+    # Degrading silently would be worse than crashing: host sign/verify
+    # drops ~80x to the pure-Python oracle and nothing else would say why
+    # (round-3 VERDICT item 5). `cryptography` is a declared dependency —
+    # its absence means a broken install, and the operator should hear it
+    # exactly once.
+    warnings.warn(
+        "the 'cryptography' package is unavailable; corda_tpu host "
+        "signing/verification falls back to the pure-Python oracle "
+        "(~80x slower) and TLS transport is disabled — run "
+        "`pip install cryptography` (it is a declared dependency; a "
+        "missing wheel means the install is broken)",
+        RuntimeWarning,
+        stacklevel=2,
+    )
 
 
 def available() -> bool:

@@ -17,16 +17,16 @@ Providers:
                  re-checked against the oracle so TPU divergence is detected
                  in production (SURVEY.md §7 hard part #5).
 
-ECDSA — an EXPLICIT deferral, not an oversight. The reference snapshot
-hardwires Ed25519 for every ledger signature: its "ECDSA"-named helpers
-construct EdDSAEngine (reference: core/src/main/kotlin/net/corda/core/crypto/
-CryptoUtilities.kt:63-96; there is no pluggable SignatureScheme SPI at 0.7).
-ECDSA secp256r1 appears ONLY in TLS/X.509 certificate plumbing
-(core/.../crypto/X509Utilities.kt:44-48), never on the transaction hot path,
-so a batched ECDSA verify kernel would have zero reference workload to serve.
-If later parity targets need it (TLS transport or post-0.7 Crypto SPI), the
-BatchVerifier seam is where it plugs in: VerifyJob grows a scheme tag and a
-secp256r1/k1 kernel joins ed25519_jax behind the same provider.
+ECDSA P-256: the reference snapshot hardwires Ed25519 for every ledger
+signature (its "ECDSA"-named helpers construct EdDSAEngine, reference:
+core/src/main/kotlin/net/corda/core/crypto/CryptoUtilities.kt:63-96; no
+pluggable SignatureScheme SPI at 0.7); secp256r1 appears ONLY in TLS/X.509
+plumbing (core/.../crypto/X509Utilities.kt:44-48). The seam nonetheless
+exists here: VerifyJob carries a `scheme` tag, mixed batches split by scheme
+(ed25519 → the batched kernel path, ecdsa-p256 → the host oracle in
+crypto/ref_ecdsa_p256.py) and recombine in order. A device ECDSA kernel can
+slot behind the same tag if a workload ever warrants it — today none does,
+so the host oracle is the honest implementation.
 """
 
 from __future__ import annotations
@@ -43,15 +43,40 @@ from . import fast_ed25519, ref_ed25519
 
 @dataclass(frozen=True)
 class VerifyJob:
-    """One signature check: does `sig` by `pubkey` cover `message`?"""
+    """One signature check: does `sig` by `pubkey` cover `message`?
+
+    scheme routes the job: "ed25519" (every ledger signature — the batched
+    kernel path) or "ecdsa-p256" (the TLS/X.509 scheme, reference:
+    core/.../crypto/X509Utilities.kt:44-48 — host oracle path). Mixed-scheme
+    batches split by scheme and recombine in order; unknown schemes reject.
+    """
 
     pubkey: bytes
     message: bytes
     sig: bytes
+    scheme: str = "ed25519"
+
+
+def _dispatch_mixed(jobs: Sequence[VerifyJob], ed25519_fn) -> np.ndarray:
+    """Split a mixed-scheme batch: the ed25519 subset goes to `ed25519_fn`
+    (each provider's batched path); ecdsa-p256 jobs verify on the host
+    oracle; unknown schemes reject. Results recombine in input order."""
+    out = np.zeros(len(jobs), bool)
+    ed_idx = [i for i, j in enumerate(jobs) if j.scheme == "ed25519"]
+    if ed_idx:
+        ed_ok = ed25519_fn([jobs[i] for i in ed_idx])
+        for k, i in enumerate(ed_idx):
+            out[i] = ed_ok[k]
+    for i, job in enumerate(jobs):
+        if job.scheme == "ecdsa-p256":
+            from . import ref_ecdsa_p256
+
+            out[i] = ref_ecdsa_p256.verify(job.pubkey, job.message, job.sig)
+    return out
 
 
 class BatchVerifier:
-    """Interface: verify many independent Ed25519 signatures at once."""
+    """Interface: verify many independent signatures at once."""
 
     name = "abstract"
 
@@ -71,10 +96,10 @@ class CpuVerifier(BatchVerifier):
     name = "cpu-openssl"
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        return np.array(
-            [fast_ed25519.verify(j.pubkey, j.message, j.sig) for j in jobs],
+        return _dispatch_mixed(jobs, lambda ed: np.array(
+            [fast_ed25519.verify(j.pubkey, j.message, j.sig) for j in ed],
             bool,
-        )
+        ))
 
 
 class OracleVerifier(BatchVerifier):
@@ -84,9 +109,26 @@ class OracleVerifier(BatchVerifier):
     name = "cpu-oracle"
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        return np.array(
-            [ref_ed25519.verify(j.pubkey, j.message, j.sig) for j in jobs], bool
-        )
+        return _dispatch_mixed(jobs, lambda ed: np.array(
+            [ref_ed25519.verify(j.pubkey, j.message, j.sig) for j in ed],
+            bool,
+        ))
+
+
+def _shadow_check(jobs: Sequence[VerifyJob], out: np.ndarray,
+                  shadow_rate: float, rng: random.Random) -> None:
+    """Re-verify a sample of kernel results on the CPU oracle; a mismatch
+    raises RuntimeError (divergence must never be silent)."""
+    if shadow_rate <= 0.0:
+        return
+    for i in range(len(jobs)):
+        if rng.random() < shadow_rate:
+            want = ref_ed25519.verify(
+                jobs[i].pubkey, jobs[i].message, jobs[i].sig)
+            if bool(out[i]) != want:
+                raise RuntimeError(
+                    f"TPU/CPU verify divergence at index {i}: "
+                    f"kernel={bool(out[i])} oracle={want}")
 
 
 class JaxVerifier(BatchVerifier):
@@ -103,24 +145,64 @@ class JaxVerifier(BatchVerifier):
         self._rng = rng or random.Random(0)
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        from ..ops import ed25519_jax
-
         if not jobs:
             return np.zeros(0, bool)
+        return _dispatch_mixed(jobs, self._verify_ed25519)
+
+    def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        from ..ops import ed25519_jax
+
         out = ed25519_jax.verify_batch(
             [j.pubkey for j in jobs], [j.message for j in jobs], [j.sig for j in jobs]
         )
-        if self.shadow_rate > 0.0:
-            for i in range(len(jobs)):
-                if self._rng.random() < self.shadow_rate:
-                    want = ref_ed25519.verify(
-                        jobs[i].pubkey, jobs[i].message, jobs[i].sig
-                    )
-                    if bool(out[i]) != want:
-                        raise RuntimeError(
-                            f"TPU/CPU verify divergence at index {i}: "
-                            f"kernel={bool(out[i])} oracle={want}"
-                        )
+        _shadow_check(jobs, out, self.shadow_rate, self._rng)
+        return out
+
+
+class MeshVerifier(BatchVerifier):
+    """SPMD verify over a device mesh: the batch axis of every verify batch
+    is sharded across the local devices with shard_map (ops/sharded.py), so
+    a multi-chip slice verifies one notary batch cooperatively — the
+    whitepaper's "signatures can easily be verified in parallel" realised
+    across chips (reference: docs/source/whitepaper/
+    corda-technical-whitepaper.tex:1597-1604).
+
+    Selectable as ``verifier = "jax-sharded"`` in node config or
+    CORDA_TPU_VERIFIER. The mesh spans all local devices by default
+    (n_devices limits it); construction is lazy so importing the provider
+    costs nothing on hosts without an initialised backend.
+    """
+
+    name = "jax-sharded"
+
+    def __init__(self, n_devices: int | None = None,
+                 shadow_rate: float = 0.0,
+                 rng: random.Random | None = None):
+        self.n_devices = n_devices
+        self.shadow_rate = shadow_rate
+        self._rng = rng or random.Random(0)
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..ops import sharded
+
+            self._mesh = sharded.make_mesh(self.n_devices)
+        return self._mesh
+
+    def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        if not jobs:
+            return np.zeros(0, bool)
+        return _dispatch_mixed(jobs, self._verify_ed25519)
+
+    def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        from ..ops import sharded
+
+        out = sharded.verify_batch_sharded(
+            [j.pubkey for j in jobs], [j.message for j in jobs],
+            [j.sig for j in jobs], self.mesh)
+        _shadow_check(jobs, out, self.shadow_rate, self._rng)
         return out
 
 
@@ -133,13 +215,25 @@ def get_verifier() -> BatchVerifier:
     global _default
     if _default is None:
         choice = os.environ.get("CORDA_TPU_VERIFIER", "cpu")
-        if choice == "jax":
-            _default = JaxVerifier()
-        elif choice == "jax-shadow":
-            _default = JaxVerifier(shadow_rate=0.05)
-        else:
-            _default = CpuVerifier()
+        _default = make_verifier(choice)
     return _default
+
+
+def make_verifier(kind: str) -> BatchVerifier:
+    """Provider factory shared by the env default and NodeConfig.verifier:
+    cpu | jax | jax-shadow | jax-sharded. Unknown names raise — a typo
+    must not silently demote a notary to the CPU path."""
+    if kind == "jax":
+        return JaxVerifier()
+    if kind == "jax-shadow":
+        return JaxVerifier(shadow_rate=0.05)
+    if kind == "jax-sharded":
+        return MeshVerifier()
+    if kind == "cpu":
+        return CpuVerifier()
+    raise ValueError(
+        f"unknown verifier {kind!r}: expected cpu | jax | jax-shadow | "
+        "jax-sharded")
 
 
 def set_verifier(verifier: BatchVerifier | None) -> None:

@@ -114,6 +114,10 @@ class ResolveTransactionsFlow(FlowLogic):
             # from_disk items are already verified and recorded locally;
             # only fresh downloads enter the verify queue.
             downloads = list(fetched.downloaded)
+            # Batch the id recompute of the whole download wave (device
+            # kernel above the crossover size) instead of hashing each
+            # transaction's components on first .tx touch below.
+            SignedTransaction.prime_ids(downloads)
             yield from self._fetch_missing_attachments([s.tx for s in downloads])
             for dep in downloads:
                 result_q.setdefault(dep.id, dep)

@@ -48,7 +48,7 @@ class NodeConfig:
     map_node: str | None = None  # use the named node's directory service
     tls: bool = False  # mutual TLS on the transport (dev CA auto-generated)
     web_port: int | None = None  # HTTP API (status/metrics/attachments)
-    verifier: str = "cpu"  # cpu | jax | jax-shadow
+    verifier: str = "cpu"  # cpu | jax | jax-shadow | jax-sharded
     batch: BatchConfig = field(default_factory=BatchConfig)
     # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
     rpc_users: tuple = ()

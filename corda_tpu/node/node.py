@@ -22,7 +22,6 @@ from __future__ import annotations
 import sys
 import time
 
-from ..crypto.provider import CpuVerifier, JaxVerifier
 from ..flows.data_vending import install_data_vending
 from ..utils.clock import Clock
 from .config import NetMapEntry, NodeConfig, netmap_load, netmap_register
@@ -53,11 +52,9 @@ from .statemachine import FlowHandle, StateMachineManager
 
 
 def _make_verifier(kind: str):
-    if kind == "jax":
-        return JaxVerifier()
-    if kind == "jax-shadow":
-        return JaxVerifier(shadow_rate=0.05)
-    return CpuVerifier()
+    from ..crypto.provider import make_verifier
+
+    return make_verifier(kind)
 
 
 class Node:
@@ -144,6 +141,13 @@ class Node:
             defer_verify=True,  # the run loop owns the flush policy
             defer_checkpoints=True,  # run_once flushes once per round
         )
+        # Unknown send targets trigger an on-demand refresh (a client that
+        # registered after our last periodic refresh must be reachable the
+        # moment its first SessionInit arrives). Throttled: a send to a
+        # GENUINELY unknown party retries through redelivery backoff, and
+        # each retry must not re-read the netmap file.
+        self.smm.netmap_refresh = (
+            lambda: self.refresh_netmap_maybe(every=0.25))
 
         # -- notary --------------------------------------------------------
         self.uniqueness_provider = None
@@ -340,6 +344,11 @@ class Node:
                 ):
                     self.smm.flush_pending_verifies()
                 self.smm.flush_checkpoints()
+                if self.rpc is not None:
+                    # Server-push: stream new change-feed events to RPC
+                    # subscribers inside the round (the frames ride the
+                    # durable outbox committed with it).
+                    self.rpc.push_pending()
         except BaseException:
             # The round rolled back: its deferred ACKs must not be sent
             # (senders redeliver) and in-memory flow state is now AHEAD of

@@ -69,6 +69,20 @@ class FlowHandleInfo:
 
 @register
 @dataclass(frozen=True)
+class RpcPushEvent:
+    """Server-push frame for a change subscription (the reference marshals
+    rx Observables to per-client queues, RPCDispatcher.kt:33-60; here the
+    stream rides the durable messaging transport as pushed frames with
+    ABSOLUTE cursors, so a reconnecting client resumes from its last seen
+    cursor without loss)."""
+
+    subscription_id: bytes
+    cursor: int         # absolute cursor AFTER `events`
+    events: tuple       # (kind, run_id[, path]) tuples from the change log
+
+
+@register
+@dataclass(frozen=True)
 class RpcUser:
     """reference: RPCUserService.kt — username/password/permissions."""
 
@@ -144,12 +158,25 @@ class NodeRpcOps:
 
 
 class RpcDispatcher:
-    """Server side: authenticate, dispatch, reply (RPCDispatcher.kt:33-60)."""
+    """Server side: authenticate, dispatch, reply (RPCDispatcher.kt:33-60).
+
+    Also owns PUSH subscriptions: a client subscribes to the state-machine
+    change feed once, and the node run loop pushes new events to the
+    client's address as they appear (push_pending) — the reference's
+    Observable-over-queues capability, with cursor-resume instead of
+    handle counters. Subscriptions expire unless renewed (a vanished
+    client must not grow an outbox forever).
+    """
+
+    SUBSCRIPTION_TTL_S = 120.0
 
     def __init__(self, node, users: tuple[RpcUser, ...]):
         self.ops = NodeRpcOps(node)
         self.users = {u.username: u for u in users}
+        self._node = node
         self._messaging = node.messaging
+        # subscription_id -> [sender_address, cursor, expires_at]
+        self._subscriptions: dict[bytes, list] = {}
         self._messaging.add_message_handler(RPC_TOPIC, 0, self._on_request)
 
     def _on_request(self, message: Message) -> None:
@@ -159,9 +186,60 @@ class RpcDispatcher:
             return
         if not isinstance(req, RpcRequest):
             return
-        reply = self._handle(req)
+        if req.method == "subscribe_changes":
+            reply = self._handle_subscribe(req, message.sender)
+        else:
+            reply = self._handle(req)
         self._messaging.send(TopicSession(RPC_TOPIC, 1),
                              serialize(reply).bytes, message.sender)
+
+    def _handle_subscribe(self, req: RpcRequest, sender) -> RpcReply:
+        """subscribe_changes(subscription_id, cursor) — register (or renew/
+        resume: same id re-subscribing keeps streaming from the given
+        cursor, which is how a reconnecting client resumes without loss)."""
+        user = self.users.get(req.user)
+        if user is None or user.password != req.password:
+            return RpcReply(req.request_id, False,
+                            error="authentication failed")
+        try:
+            subscription_id, cursor = req.args
+            subscription_id = bytes(subscription_id)
+            cursor = int(cursor)
+        except Exception:
+            return RpcReply(req.request_id, False,
+                            error="subscribe_changes(subscription_id, cursor)")
+        head = len(self._node.smm.changes)
+        # A cursor AHEAD of our head means the client outlived a node
+        # restart (the change log reset): snap to head so the stream
+        # resumes instead of stalling until the old cursor is re-reached.
+        # The client snaps its own cursor from the returned head too.
+        self._subscriptions[subscription_id] = [
+            sender, min(cursor, head),
+            time.monotonic() + self.SUBSCRIPTION_TTL_S]
+        return RpcReply(req.request_id, True, value=head)
+
+    def push_pending(self) -> int:
+        """Push new change-feed events to every live subscription; called
+        by the node run loop each round. Returns frames pushed."""
+        if not self._subscriptions:
+            return 0
+        now = time.monotonic()
+        pushed = 0
+        for sid in list(self._subscriptions):
+            entry = self._subscriptions[sid]
+            sender, cursor, expires_at = entry
+            if now > expires_at:
+                del self._subscriptions[sid]
+                continue
+            new_cursor, events = self._node.smm.changes.since(cursor)
+            if not events:
+                continue
+            frame = RpcPushEvent(sid, new_cursor, tuple(events))
+            self._messaging.send(TopicSession(RPC_TOPIC, 2),
+                                 serialize(frame).bytes, sender)
+            entry[1] = new_cursor
+            pushed += 1
+        return pushed
 
     def _handle(self, req: RpcRequest) -> RpcReply:
         user = self.users.get(req.user)
@@ -200,12 +278,26 @@ class RpcClient:
         self.timeout = timeout
         self._messaging = TcpMessaging(host, 0).start()
         self._replies: dict[bytes, RpcReply] = {}
+        self._decode_errors: list[str] = []
+        self._push_callbacks: dict[bytes, Any] = {}
+        self._push_cursor: dict[bytes, int] = {}
+        # subscription_id -> count of events lost to server-side eviction
+        # (the push stream is lossless only within the server's bounded
+        # retention window; holes are detected and counted, never silent).
+        self.push_gaps: dict[bytes, int] = {}
         self._messaging.add_message_handler(RPC_TOPIC, 1, self._on_reply)
+        self._messaging.add_message_handler(RPC_TOPIC, 2, self._on_push)
 
     def _on_reply(self, message: Message) -> None:
         try:
             reply = deserialize(message.data)
-        except Exception:
+        except Exception as e:
+            # The request_id is inside the undecodable payload, so the
+            # matching call() cannot be resolved — but it must NOT time out
+            # silently: the usual cause is a reply type whose codec
+            # registration module was never imported in THIS process, and
+            # that is a caller bug worth a loud message.
+            self._decode_errors.append(f"{type(e).__name__}: {e}")
             return
         if isinstance(reply, RpcReply):
             self._replies[reply.request_id] = reply
@@ -214,6 +306,10 @@ class RpcClient:
         request_id = os.urandom(12)
         req = RpcRequest(request_id, self._user, self._password, method,
                          tuple(args))
+        # Only decode failures observed DURING this call are attributed to
+        # it: a previous call's late undecodable reply must not poison an
+        # unrelated method.
+        self._decode_errors.clear()
         self._messaging.send(TopicSession(RPC_TOPIC, 0),
                              serialize(req).bytes, self._node_address)
         deadline = time.monotonic() + self.timeout
@@ -224,7 +320,84 @@ class RpcClient:
                 if not reply.ok:
                     raise RpcError(reply.error)
                 return reply.value
-        raise RpcError(f"rpc {method} timed out after {self.timeout}s")
+        # A decode error seen during the call is most likely OUR reply (a
+        # value type whose codec registration module was never imported in
+        # this process) — but it could also be a previous call's late
+        # arrival, so it must not abort a call whose own reply may still
+        # decode; it is attached to the timeout instead of being swallowed.
+        msg = f"rpc {method} timed out after {self.timeout}s"
+        if self._decode_errors:
+            errors, self._decode_errors = self._decode_errors, []
+            msg += ("; undecodable replies arrived during the call (is the "
+                    "value's codec registration module imported in this "
+                    "process?): " + "; ".join(errors))
+        raise RpcError(msg)
+
+    # -- push subscriptions -----------------------------------------------
+
+    def _on_push(self, message: Message) -> None:
+        try:
+            frame = deserialize(message.data)
+        except Exception as e:
+            self._decode_errors.append(f"{type(e).__name__}: {e}")
+            return
+        if not isinstance(frame, RpcPushEvent):
+            return
+        callback = self._push_callbacks.get(frame.subscription_id)
+        if callback is None:
+            return
+        # Frames carry the ABSOLUTE cursor after their events; the
+        # at-least-once transport may redeliver, so trim anything at or
+        # below our last seen cursor instead of double-delivering.
+        last = self._push_cursor.get(frame.subscription_id, 0)
+        if frame.cursor <= last:
+            return
+        start = frame.cursor - len(frame.events)
+        if start > last:
+            # Events between `last` and `start` were evicted server-side
+            # before we caught up (resume is lossless only within the
+            # server's bounded retention window). Never silently: count
+            # the hole and log it so a monitoring UI can say "feed
+            # incomplete" instead of showing stale truth.
+            self.push_gaps[frame.subscription_id] = (
+                self.push_gaps.get(frame.subscription_id, 0)
+                + (start - last))
+            import logging
+
+            logging.getLogger("corda_tpu.rpc").warning(
+                "push subscription %s lost %d evicted events",
+                frame.subscription_id.hex()[:8], start - last)
+        events = frame.events[max(0, last - start):]
+        self._push_cursor[frame.subscription_id] = frame.cursor
+        callback(tuple(events), frame.cursor)
+
+    def subscribe_changes(self, callback, subscription_id: bytes | None = None,
+                          cursor: int | None = None) -> bytes:
+        """Server-push subscription to the node's state-machine change feed
+        (flow add/remove/progress events). `callback(events, cursor)` fires
+        during any transport pump (a call() or poll_push()). Re-invoke with
+        the SAME id after a reconnect to resume from the last seen cursor —
+        lossless within the server's bounded retention window; larger holes
+        are detected and counted in `push_gaps`, never skipped silently.
+        Re-invoke periodically (< the server's 120 s TTL) to keep the
+        subscription alive."""
+        sid = subscription_id or os.urandom(12)
+        self._push_callbacks[sid] = callback
+        if cursor is None:
+            cursor = self._push_cursor.get(sid, 0)
+        self._push_cursor.setdefault(sid, cursor)
+        head = self.call("subscribe_changes", sid, cursor)
+        if isinstance(head, int) and head < self._push_cursor[sid]:
+            # Our cursor is beyond the server's head: the node restarted
+            # and its change log reset. Snap down so the resumed stream's
+            # frames are not dropped as duplicates (the server snapped its
+            # stored cursor the same way).
+            self._push_cursor[sid] = head
+        return sid
+
+    def poll_push(self, timeout: float = 0.05) -> None:
+        """Give pushed frames a chance to arrive outside of call()s."""
+        self._messaging.pump(timeout=timeout)
 
     # -- convenience wrappers ---------------------------------------------
 

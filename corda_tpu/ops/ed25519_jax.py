@@ -40,7 +40,8 @@ from . import fe25519 as fe
 from ..crypto import ref_ed25519 as ref
 
 __all__ = ["verify_batch", "precompute_batch", "verify_arrays", "pick_bucket",
-           "verify_core"]
+           "verify_core", "last_pallas_error", "last_backend",
+           "reset_pallas_state"]
 
 _D = ref.D
 _2D = (2 * ref.D) % ref.P
@@ -350,7 +351,21 @@ def _pack_pk_rs(pubkeys, sigs, n: int, b: int):
     return pk_cat, sig_cat, pk, r_enc, s_raw
 
 
-_PALLAS_STATE = {"available": None}
+_PALLAS_STATE = {
+    "available": None,        # None = unprobed; platform capability only
+    "consecutive_failures": 0,
+    "failures_total": 0,
+    "last_error": None,       # formatted traceback of the newest failure
+    "last_backend": None,     # "pallas" | "xla": backend of the newest call
+}
+# After this many failures IN A ROW stop retrying the Pallas kernel for the
+# rest of the process (a broken Mosaic toolchain would otherwise pay a full
+# recompile per call). One success resets the counter, so a transient
+# runtime failure (e.g. a device-allocator stall) demotes only its own call
+# — not the whole process, which is what silently cost round 3 its headline.
+PALLAS_MAX_CONSECUTIVE_FAILURES = 3
+
+_log = __import__("logging").getLogger("corda_tpu.ops.ed25519")
 
 
 def _pallas_available() -> bool:
@@ -365,22 +380,64 @@ def _pallas_available() -> bool:
             _PALLAS_STATE["available"] = jax.devices()[0].platform != "cpu"
         except Exception:
             _PALLAS_STATE["available"] = False
-    return _PALLAS_STATE["available"]
+    return (_PALLAS_STATE["available"]
+            and _PALLAS_STATE["consecutive_failures"]
+            < PALLAS_MAX_CONSECUTIVE_FAILURES)
+
+
+def last_pallas_error() -> str | None:
+    """Formatted traceback of the most recent Pallas failure (None if the
+    kernel has never failed). Bench stamps this into its report so a
+    fallback is always attributable."""
+    return _PALLAS_STATE["last_error"]
+
+
+def last_backend() -> str | None:
+    """Which backend ("pallas"/"xla") the most recent verify_arrays_auto
+    call actually dispatched to."""
+    return _PALLAS_STATE["last_backend"]
+
+
+def reset_pallas_state() -> None:
+    """Forget failure history (tests; or an operator re-enabling Pallas
+    after a fixed environment)."""
+    _PALLAS_STATE.update(available=None, consecutive_failures=0,
+                         failures_total=0, last_error=None,
+                         last_backend=None)
 
 
 def verify_arrays_auto(a_words, r_words, s_words, h_words):
     """Best available backend for the word-array contract: the VMEM-resident
     Pallas kernel on TPU (batch must be a multiple of 1024), the plain XLA
-    graph otherwise. Falls back to XLA if the Mosaic compile fails."""
+    graph otherwise.
+
+    A Pallas failure falls back to XLA for THIS call only, loudly: the
+    exception is logged with its stack and kept in last_pallas_error().
+    Only PALLAS_MAX_CONSECUTIVE_FAILURES failures in a row disable the
+    kernel for the rest of the process.
+    """
     n = a_words.shape[1]
     if _pallas_available() and n % 1024 == 0:
         from . import ed25519_pallas
 
         try:
-            return ed25519_pallas.verify_arrays_pallas(
+            out = ed25519_pallas.verify_arrays_pallas(
                 a_words, r_words, s_words, h_words)
-        except Exception:  # Mosaic regression: stay correct on the XLA path
-            _PALLAS_STATE["available"] = False
+            _PALLAS_STATE["consecutive_failures"] = 0
+            _PALLAS_STATE["last_backend"] = "pallas"
+            return out
+        except Exception:
+            import traceback
+
+            _PALLAS_STATE["consecutive_failures"] += 1
+            _PALLAS_STATE["failures_total"] += 1
+            _PALLAS_STATE["last_error"] = traceback.format_exc()
+            _log.exception(
+                "Pallas verify failed (n=%d, consecutive failure %d/%d); "
+                "falling back to the XLA graph for this call",
+                n, _PALLAS_STATE["consecutive_failures"],
+                PALLAS_MAX_CONSECUTIVE_FAILURES)
+    _PALLAS_STATE["last_backend"] = "xla"
     return verify_arrays(a_words, r_words, s_words, h_words)
 
 

@@ -26,8 +26,18 @@ import jax.numpy as jnp
 
 __all__ = [
     "sha256_blocks", "pack_messages", "sha256_fixed", "sha256_many",
-    "sha256_pair_words", "merkle_root_device",
+    "sha256_pair_words", "merkle_root_device", "merkle_roots_device",
+    "hash_many_auto",
 ]
+
+# Below this many messages one hashlib loop beats the kernel end-to-end:
+# the device win is batch-parallelism, and host packing + transfer overhead
+# amortises only at scale. Measured on the axon-tunnelled v5e (2026-07-30):
+# kernel-resident crosses hashlib at ~64k hashes (534k/s vs 442k/s), while
+# TRANSFER-inclusive e2e stays host-bound on the ~5 MB/s tunnel; a directly-
+# attached chip (PCIe/ICI, GB/s) moves the crossover down by orders of
+# magnitude. Override with CORDA_TPU_SHA256_DEVICE_MIN.
+DEVICE_MIN_HASHES_DEFAULT = 65536
 
 U32 = jnp.uint32
 
@@ -169,6 +179,67 @@ def sha256_pair_words(left, right):
                       block1)
     pad = jnp.broadcast_to(jnp.asarray(_PAD_BLOCK_64, U32)[:, None], (16, n))
     return _compress(state, pad)
+
+
+def hash_many_auto(msgs: list[bytes],
+                   device_min: int | None = None) -> tuple[list[bytes], str]:
+    """(digests, backend): hashlib below the crossover batch size, the
+    batched device kernel at or above it. The ONE dispatch predicate for
+    framework bulk hashing (the resolve path's tx-id recomputation); the
+    host path is also the fallback if the kernel fails — semantics are
+    bit-identical either way."""
+    import hashlib
+    import os
+
+    if device_min is None:
+        device_min = int(os.environ.get("CORDA_TPU_SHA256_DEVICE_MIN",
+                                        DEVICE_MIN_HASHES_DEFAULT))
+    if len(msgs) >= device_min:
+        try:
+            return sha256_many(msgs), "device"
+        except Exception:
+            import logging
+
+            logging.getLogger("corda_tpu.ops.sha256").exception(
+                "device sha256 failed for %d messages; host fallback",
+                len(msgs))
+    return [hashlib.sha256(m).digest() for m in msgs], "host"
+
+
+def merkle_roots_device(leaf_digest_groups: list[list[bytes]]) -> list[bytes]:
+    """Many Merkle roots (odd-duplicate rule) in batched device calls.
+
+    Trees are bucketed by leaf count; every same-count tree reduces
+    level-by-level TOGETHER (one sha256_pair_words call hashes the level's
+    nodes of every tree in the bucket). The per-tree semantics match
+    crypto.merkle.MerkleTree.build bit-for-bit.
+    """
+    out: list[bytes | None] = [None] * len(leaf_digest_groups)
+    buckets: dict[int, list[int]] = {}
+    for i, leaves in enumerate(leaf_digest_groups):
+        if not leaves:
+            raise ValueError("Cannot calculate Merkle root on empty hash list.")
+        buckets.setdefault(len(leaves), []).append(i)
+    for n_leaves, idxs in buckets.items():
+        m = len(idxs)
+        flat = b"".join(b"".join(leaf_digest_groups[i]) for i in idxs)
+        arr = np.frombuffer(flat, np.uint8).reshape(m * n_leaves, 32)
+        words = np.ascontiguousarray(arr).view(">u4").astype(np.uint32)
+        level = jnp.asarray(words.reshape(m, n_leaves, 8).transpose(2, 0, 1),
+                            U32)  # (8, m, L)
+        width = n_leaves
+        while width > 1:
+            if width % 2:
+                level = jnp.concatenate([level, level[:, :, -1:]], axis=2)
+                width += 1
+            left = level[:, :, 0::2].reshape(8, -1)
+            right = level[:, :, 1::2].reshape(8, -1)
+            level = sha256_pair_words(left, right).reshape(8, m, width // 2)
+            width //= 2
+        digests = _digest_bytes(level.reshape(8, m))
+        for j, i in enumerate(idxs):
+            out[i] = digests[j].tobytes()
+    return out  # type: ignore[return-value]
 
 
 def merkle_root_device(leaf_hashes: list[bytes]) -> bytes:

@@ -108,6 +108,38 @@ class NodeProcess:
 
         self.process.send_signal(signal.SIGCONT)
 
+    def strain(self, seconds: float = 5.0, duty: float = 0.8,
+               period: float = 0.1) -> "threading.Thread":
+        """CPU-strain disruption (reference: Disruption.kt strainCpu): the
+        node is made SLOW-BUT-ALIVE — frozen for `duty` of every `period`
+        via SIGSTOP/SIGCONT duty-cycling on a background thread, the
+        portable equivalent of the reference's openssl busy-loop siblings.
+        Sockets stay open; peers see a node that responds, late — the
+        failure mode that exposes timeout tuning, distinct from both a
+        clean kill and a full hang. Returns the (daemon) thread; join it to
+        wait the strain out."""
+        import threading
+
+        def cycle():
+            end = time.monotonic() + seconds
+            while time.monotonic() < end and self.process.poll() is None:
+                try:
+                    self.sigstop()
+                    time.sleep(duty * period)
+                    self.sigcont()
+                    time.sleep((1.0 - duty) * period)
+                except (OSError, ValueError):
+                    return  # process gone mid-cycle
+            try:  # never leave the node frozen
+                self.sigcont()
+            except (OSError, ValueError):
+                pass
+
+        t = threading.Thread(target=cycle, daemon=True,
+                             name=f"strain-{self.name}")
+        t.start()
+        return t
+
     def terminate(self) -> None:
         self.process.terminate()
         try:

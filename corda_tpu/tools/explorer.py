@@ -97,25 +97,49 @@ class ExplorerModel:
 
     MAX_TX, MAX_EVENTS = 50, 200
 
+    # Renew the push subscription well inside the server's 120 s TTL.
+    RESUBSCRIBE_S = 30.0
+
     def __init__(self, rpc: RpcClient):
         self.rpc = rpc
         self._cursor = 0
         self._events: list = []
+        # Flow events arrive as SERVER-PUSHED frames (RpcClient.
+        # subscribe_changes): the node streams its change feed to us and
+        # _on_pushed accumulates it; gather() only drains the transport.
+        # The subscription id is sticky, so a reconnect resumes from the
+        # last pushed cursor without loss.
+        self._subscription_id: bytes | None = None
+        self._subscribed_at = 0.0
         # Transactions are immutable and content-addressed: fetch each hash
         # over RPC once, ever, instead of ~MAX_TX round trips per poll.
         self._tx_cache: dict = {}
 
+    def _on_pushed(self, events: tuple, cursor: int) -> None:
+        self._events.extend(events)
+        self._cursor = cursor
+        del self._events[:-self.MAX_EVENTS]
+
+    def _ensure_subscribed(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._subscribed_at < self.RESUBSCRIBE_S:
+            return
+        self._subscription_id = self.rpc.subscribe_changes(
+            self._on_pushed, subscription_id=self._subscription_id,
+            cursor=self._cursor)
+        self._subscribed_at = now
+
     def gather(self) -> dict:
         rpc = self.rpc
+        self._ensure_subscribed()
         identity = rpc.call("node_identity")
         network = rpc.call("network_map_snapshot")
         vault = rpc.call("vault_snapshot")
         in_flight = rpc.call("state_machines_snapshot")
         metrics = rpc.call("node_metrics")
-        self._cursor, new_events = rpc.call(
-            "state_machine_changes", self._cursor)
-        self._events.extend(new_events)
-        del self._events[:-self.MAX_EVENTS]
+        rpc.poll_push()  # drain any pushed frames not seen during calls
 
         transactions = []
         seen = set()

@@ -87,6 +87,52 @@ class SignedTransaction:
             object.__setattr__(self, "_tx", cached)
         return cached
 
+    @staticmethod
+    def prime_ids(stxs: "Sequence[SignedTransaction]",
+                  device_min: int | None = None) -> str:
+        """Batch the id cross-check of many payloads: every component leaf
+        of every transaction hashes in ONE bulk call (the device kernel
+        above the crossover batch size, hashlib below — ops/sha256_jax.
+        hash_many_auto), and the per-object caches are seeded so later
+        .tx / .id accesses are hits. Semantics are identical to touching
+        .tx one transaction at a time, including the mismatch ValueError.
+
+        This is the batched form of the reference's per-component hashing
+        on the validating-notary resolve path (reference:
+        core/.../transactions/MerkleTransaction.kt:26-38 driven by
+        ResolveTransactionsFlow.kt:105-111). Returns the hashing backend
+        used ("host" | "device") for bench attribution.
+        """
+        from ..crypto.hashes import SecureHash
+        from ..ops import sha256_jax
+        from ..serialization.codec import serialize
+
+        todo = [stx for stx in stxs if getattr(stx, "_tx", None) is None]
+        wtxs: list[WireTransaction] = []
+        flat: list[bytes] = []
+        spans: list[tuple[int, int]] = []
+        for stx in todo:
+            wtx = stx.tx_bits.deserialize()
+            comps = [serialize(x).bytes
+                     for group in (wtx.inputs, wtx.outputs,
+                                   wtx.attachments, wtx.commands)
+                     for x in group]
+            spans.append((len(flat), len(flat) + len(comps)))
+            flat.extend(comps)
+            wtxs.append(wtx)
+        digests, backend = sha256_jax.hash_many_auto(flat,
+                                                     device_min=device_min)
+        for stx, wtx, (lo, hi) in zip(todo, wtxs, spans):
+            object.__setattr__(
+                wtx, "_leaves", [SecureHash(d) for d in digests[lo:hi]])
+            if wtx.id != stx.id:  # tree reduce over the seeded leaves
+                raise ValueError(
+                    "Supplied transaction ID does not match deserialized "
+                    "transaction's ID"
+                )
+            object.__setattr__(stx, "_tx", wtx)
+        return backend
+
     # -- signature verification (the hot path) ----------------------------
 
     def check_signatures_are_valid(self) -> None:

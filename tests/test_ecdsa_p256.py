@@ -1,0 +1,134 @@
+"""ECDSA P-256 oracle conformance + mixed-scheme provider routing.
+
+The oracle (corda_tpu/crypto/ref_ecdsa_p256.py) must agree with OpenSSL
+(the `cryptography` wheel) on accepts AND rejects — golden vectors plus
+mutation fuzzing — and the provider seam must route mixed ed25519 /
+ecdsa-p256 batches correctly (reference scheme usage:
+core/.../crypto/X509Utilities.kt:44-48).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives import hashes as c_hashes
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from corda_tpu.crypto import ref_ecdsa_p256 as oracle
+from corda_tpu.crypto import ref_ed25519
+
+
+def _keypair(i: int = 1):
+    key = ec.derive_private_key(0x1000 + i, ec.SECP256R1())
+    pub = key.public_key().public_bytes(
+        Encoding.X962, PublicFormat.UncompressedPoint)
+    return key, pub
+
+
+def _openssl_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    try:
+        ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256R1(), pub).verify(sig, msg, ec.ECDSA(c_hashes.SHA256()))
+        return True
+    except Exception:
+        return False
+
+
+def test_golden_accepts():
+    for i in range(4):
+        key, pub = _keypair(i)
+        msg = b"tx-%d" % i
+        sig = key.sign(msg, ec.ECDSA(c_hashes.SHA256()))
+        assert oracle.verify(pub, msg, sig)
+        assert _openssl_verify(pub, msg, sig)
+
+
+def test_golden_rejects():
+    key, pub = _keypair()
+    msg = b"message"
+    sig = key.sign(msg, ec.ECDSA(c_hashes.SHA256()))
+    r, s = decode_dss_signature(sig)
+    cases = [
+        (pub, b"other", sig),                        # wrong message
+        (pub, msg, encode_dss_signature(r ^ 1, s)),  # r tampered
+        (pub, msg, encode_dss_signature(r, s ^ 1)),  # s tampered
+        (pub, msg, b""),                             # empty sig
+        (pub, msg, b"\x30\x02\x02\x00"),             # garbage DER
+        (pub, msg, sig[:-1]),                        # truncated DER
+        (pub, msg, sig + b"\x00"),                   # trailing bytes
+        (pub[:-1], msg, sig),                        # truncated key
+        (b"\x02" + pub[1:], msg, sig),               # compressed prefix
+        (pub[:1] + b"\x00" * 64, msg, sig),          # off-curve point
+    ]
+    for p, m, sg in cases:
+        assert not oracle.verify(p, m, sg), (p[:2], m, sg[:4])
+        assert not _openssl_verify(p, m, sg)
+    # range violations: r/s = 0 or n encode fine but must reject
+    assert not oracle.verify(pub, msg, encode_dss_signature(0, s))
+    assert not oracle.verify(pub, msg, encode_dss_signature(r, oracle.N))
+
+
+def test_high_s_accepted_like_jca():
+    # No low-s rule in JCA/BC or OpenSSL verify: (r, n - s) also verifies.
+    key, pub = _keypair()
+    msg = b"mutable-s"
+    sig = key.sign(msg, ec.ECDSA(c_hashes.SHA256()))
+    r, s = decode_dss_signature(sig)
+    high = encode_dss_signature(r, oracle.N - s)
+    assert oracle.verify(pub, msg, high)
+    assert _openssl_verify(pub, msg, high)
+
+
+def test_mutation_fuzz_agrees_with_openssl():
+    import random
+
+    rng = random.Random(5)
+    key, pub = _keypair()
+    msg = b"fuzz-me"
+    sig = bytearray(key.sign(msg, ec.ECDSA(c_hashes.SHA256())))
+    agreements = 0
+    for _ in range(60):
+        mutated = bytearray(sig)
+        for _ in range(rng.randrange(1, 3)):
+            mutated[rng.randrange(len(mutated))] ^= 1 << rng.randrange(8)
+        got = oracle.verify(pub, msg, bytes(mutated))
+        want = _openssl_verify(pub, msg, bytes(mutated))
+        assert got == want, (bytes(mutated).hex(), got, want)
+        agreements += 1
+    assert agreements == 60
+
+
+def test_mixed_scheme_batch_routes_by_scheme():
+    from corda_tpu.crypto.provider import CpuVerifier, JaxVerifier, VerifyJob
+
+    ec_key, ec_pub = _keypair()
+    ec_msg = b"tls-handshake-blob"
+    ec_sig = ec_key.sign(ec_msg, ec.ECDSA(c_hashes.SHA256()))
+
+    ed_seed = b"\x21" * 32
+    ed_pub = ref_ed25519.public_key(ed_seed)
+    ed_msg = hashlib.sha256(b"ledger-tx").digest()
+    ed_sig = ref_ed25519.sign(ed_seed, ed_msg)
+
+    jobs = [
+        VerifyJob(ed_pub, ed_msg, ed_sig),                       # ok
+        VerifyJob(ec_pub, ec_msg, ec_sig, scheme="ecdsa-p256"),  # ok
+        VerifyJob(ed_pub, ed_msg, ec_sig),                       # cross: bad
+        VerifyJob(ec_pub, ec_msg, ed_sig, scheme="ecdsa-p256"),  # cross: bad
+        VerifyJob(ed_pub, ed_msg, ed_sig, scheme="rsa-4096"),    # unknown
+        VerifyJob(ec_pub, b"other", ec_sig, scheme="ecdsa-p256"),
+    ]
+    want = [True, True, False, False, False, False]
+    for verifier in (CpuVerifier(), JaxVerifier()):
+        got = verifier.verify_batch(jobs)
+        assert isinstance(got, np.ndarray)
+        assert got.tolist() == want, (verifier.name, got.tolist())

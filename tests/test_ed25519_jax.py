@@ -282,3 +282,61 @@ def test_device_hash_path_rejects_mixed_length_messages():
     msgs = [msgs[0][:31], msgs[1] + b"\x00"]
     with pytest.raises(ValueError, match="32-byte"):
         kernel.precompute_batch_device(pks, msgs, sigs, bucket=32)
+
+
+def test_pallas_fallback_is_per_call_and_recorded(monkeypatch):
+    # Round-3 postmortem: a single transient Pallas failure must demote only
+    # its own call (logged + recorded), NOT flip the process to XLA forever.
+    from corda_tpu.ops import ed25519_pallas
+
+    kernel.reset_pallas_state()
+    kernel._PALLAS_STATE["available"] = True  # pretend a TPU is present
+    calls = {"pallas": 0}
+
+    def fake_pallas(a, r, s, h):
+        calls["pallas"] += 1
+        if calls["pallas"] == 1:
+            raise RuntimeError("transient allocator hiccup")
+        return "pallas-result"
+
+    monkeypatch.setattr(ed25519_pallas, "verify_arrays_pallas", fake_pallas)
+    monkeypatch.setattr(kernel, "verify_arrays", lambda *a: "xla-result")
+    arr = np.zeros((8, 1024), np.uint32)
+    try:
+        out = kernel.verify_arrays_auto(arr, arr, arr, arr)
+        assert out == "xla-result"
+        assert kernel.last_backend() == "xla"
+        assert "transient allocator hiccup" in kernel.last_pallas_error()
+        # The very next call retries Pallas and succeeds.
+        out = kernel.verify_arrays_auto(arr, arr, arr, arr)
+        assert out == "pallas-result"
+        assert kernel.last_backend() == "pallas"
+        assert kernel._PALLAS_STATE["consecutive_failures"] == 0
+        # last_pallas_error stays for attribution even after recovery.
+        assert kernel.last_pallas_error() is not None
+    finally:
+        kernel.reset_pallas_state()
+
+
+def test_pallas_disabled_after_consecutive_failures(monkeypatch):
+    from corda_tpu.ops import ed25519_pallas
+
+    kernel.reset_pallas_state()
+    kernel._PALLAS_STATE["available"] = True
+    calls = {"pallas": 0}
+
+    def always_fail(a, r, s, h):
+        calls["pallas"] += 1
+        raise RuntimeError("mosaic regression")
+
+    monkeypatch.setattr(ed25519_pallas, "verify_arrays_pallas", always_fail)
+    monkeypatch.setattr(kernel, "verify_arrays", lambda *a: "xla-result")
+    arr = np.zeros((8, 1024), np.uint32)
+    try:
+        for _ in range(kernel.PALLAS_MAX_CONSECUTIVE_FAILURES + 2):
+            assert kernel.verify_arrays_auto(arr, arr, arr, arr) == "xla-result"
+        # Retried exactly MAX times, then stopped paying the recompile tax.
+        assert calls["pallas"] == kernel.PALLAS_MAX_CONSECUTIVE_FAILURES
+        assert kernel._PALLAS_STATE["failures_total"] == calls["pallas"]
+    finally:
+        kernel.reset_pallas_state()

@@ -58,3 +58,59 @@ def test_pair_words_is_hash_concat():
     b = SecureHash.sha256(b"right")
     got = sj.merkle_root_device([a.bytes, b.bytes])
     assert got == a.hash_concat(b).bytes
+
+
+def test_merkle_roots_device_batched_matches_host():
+    # Same-leaf-count trees reduce together; mixed counts bucket. Must match
+    # MerkleTree.build (odd-duplicate rule) bit-for-bit at every size.
+    rng = random.Random(7)
+    groups = []
+    for n_leaves in (1, 2, 3, 4, 5, 7, 8, 9, 3, 8):
+        groups.append([rng.randbytes(32) for _ in range(n_leaves)])
+    got = sj.merkle_roots_device(groups)
+    for g, leaves in zip(got, groups):
+        want = MerkleTree.build([SecureHash(h) for h in leaves]).hash.bytes
+        assert g == want
+
+
+def test_hash_many_auto_backends_agree():
+    msgs = [b"x" * n for n in range(0, 300, 7)]
+    host, hb = sj.hash_many_auto(msgs, device_min=10**9)
+    dev, db = sj.hash_many_auto(msgs, device_min=0)
+    assert hb == "host" and db == "device"
+    assert host == dev == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_prime_ids_seeds_caches_and_detects_tampering():
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.crypto.party import Party
+    from corda_tpu.testing.dummies import DummyContract
+    from corda_tpu.transactions.signed import SignedTransaction
+
+    notary = Party.of("N", KeyPair.generate(b"\x51" * 32).public)
+    party = Party.of("P", KeyPair.generate(b"\x52" * 32).public)
+    stxs = []
+    for i in range(6):
+        b = DummyContract.generate_initial(party.ref(bytes([i + 1])), i, notary)
+        b.sign_with(KeyPair.generate(b"\x52" * 32))
+        stxs.append(b.to_signed_transaction(check_sufficient_signatures=False))
+
+    # Strip caches by round-tripping through the codec.
+    from corda_tpu.serialization.codec import deserialize, serialize
+    fresh = [deserialize(serialize(s).bytes) for s in stxs]
+    for backend_min in (10**9, 0):  # host path, then device path
+        batch = [deserialize(serialize(s).bytes) for s in stxs]
+        backend = SignedTransaction.prime_ids(batch, device_min=backend_min)
+        assert backend == ("host" if backend_min else "device")
+        for got, want in zip(batch, stxs):
+            assert got.tx.id == want.tx.id  # cache hit, same id
+
+    # A tampered payload must raise the same mismatch error .tx raises.
+    import dataclasses
+    victim = deserialize(serialize(stxs[0]).bytes)
+    bad = dataclasses.replace(victim, id=stxs[1].id)
+    try:
+        SignedTransaction.prime_ids([bad])
+        raise AssertionError("tampered id accepted")
+    except ValueError as e:
+        assert "does not match" in str(e)
