@@ -4,10 +4,11 @@ Capability match for the reference's simm-valuation-demo flows (reference:
 samples/simm-valuation-demo/src/main/kotlin/net/corda/vega/flows/SimmFlow.kt
 — two parties deterministically value their shared portfolio and agree the
 result on-ledger; PortfolioState/PortfolioValuation in .../contracts). The
-OpenGamma margin model is out of scope; the valuation here is a transparent
-deterministic function of the portfolio's notionals and an oracle rate, which
-preserves the demo's actual protocol content: both sides compute
-independently, compare, and only an AGREED valuation reaches the ledger.
+margin number comes from the sensitivities-based fixed-point SIMM model in
+corda_tpu/tools/simm.py (per-trade tenor-bucket sensitivities + risk-weight
+and correlation aggregation — the reference's AnalyticsEngine.kt pipeline in
+integer arithmetic): both sides compute independently from the shared trades
+and oracle fix, compare, and only an AGREED valuation reaches the ledger.
 """
 
 from __future__ import annotations
@@ -81,7 +82,7 @@ class PortfolioState(DealState):
     party_b: Party = None  # type: ignore[assignment]
     oracle: Party = None  # type: ignore[assignment]
     rate_ref: FixOf = None  # type: ignore[assignment]
-    notionals: tuple[int, ...] = ()
+    trades: tuple = ()  # tuple[simm.IRSTrade, ...]
     valuation: int | None = None
     uid: UniqueIdentifier = field(default_factory=UniqueIdentifier)
 
@@ -102,11 +103,13 @@ class PortfolioState(DealState):
         return [self.party_a, self.party_b]
 
 
-def compute_valuation(notionals, rate: int) -> int:
-    """The deterministic margin model both sides run independently
-    (stand-in for the reference's OpenGamma IM calculation): rate-weighted
-    gross notional, scaled by the oracle's 10^4 fixed-point rate."""
-    return sum(abs(n) for n in notionals) * rate // 10_000
+def compute_valuation(trades, rate: int) -> int:
+    """The deterministic margin model both sides run independently: the
+    sensitivities-based fixed-point SIMM pipeline (tools/simm.py) on the
+    shared trades and the oracle's rate fix."""
+    from .simm import initial_margin
+
+    return initial_margin(trades, rate)
 
 
 @register_flow
@@ -131,7 +134,7 @@ class SimmValuationFlow(FlowLogic):
 
         fix = yield from self.sub_flow(
             RatesFixQueryFlow(portfolio.oracle, portfolio.rate_ref))
-        my_valuation = compute_valuation(portfolio.notionals, fix.value)
+        my_valuation = compute_valuation(portfolio.trades, fix.value)
 
         # Consensus on the number BEFORE anything is signed (SimmFlow's
         # agree step): the counterparty recomputes and must match.
@@ -177,7 +180,7 @@ class SimmValuationResponder(FlowLogic):
         portfolio = state.data
         fix = yield from self.sub_flow(
             RatesFixQueryFlow(portfolio.oracle, portfolio.rate_ref))
-        my_valuation = compute_valuation(portfolio.notionals, fix.value)
+        my_valuation = compute_valuation(portfolio.trades, fix.value)
         yield self.send(self.other_party, my_valuation)
         if my_valuation != their_valuation:
             return None  # disagreement: nothing further to sign

@@ -116,12 +116,16 @@ class TestSimmValuation:
             b = net.create_node("Dealer B")
             o = net.create_node("Oracle")
             rate_ref = FixOf("IM-RATE", 20_200, "1D")
-            RateOracle(o.smm, o.key, {rate_ref: 2_5000})  # 2.5 scaled 1e4
+            RateOracle(o.smm, o.key, {rate_ref: 2_5000})  # 2.5% (1e-2 bp)
             install_simm_responder(b.smm)
 
+            from corda_tpu.tools.simm import IRSTrade
+            trades = (IRSTrade(1_000_000, 260, 5 * 365),
+                      IRSTrade(-400_000, 240, 2 * 365),
+                      IRSTrade(250_000, 255, 10 * 365))
             portfolio = PortfolioState(
                 party_a=a.identity, party_b=b.identity, oracle=o.identity,
-                rate_ref=rate_ref, notionals=(1_000, -400, 250))
+                rate_ref=rate_ref, trades=trades)
             tx = TransactionBuilder(notary=notary.identity)
             tx.add_output_state(portfolio)
             tx.add_command(Command(ValueCommand(), (a.identity.owning_key,
@@ -137,8 +141,9 @@ class TestSimmValuation:
             final = handle.result.result()
             valued = [s.data for s in final.tx.outputs
                       if isinstance(s.data, PortfolioState)]
-            expected = compute_valuation((1_000, -400, 250), 2_5000)
-            assert valued[0].valuation == expected == 4125
+            expected = compute_valuation(trades, 2_5000)
+            assert expected > 0  # a real margin, not a degenerate zero
+            assert valued[0].valuation == expected
             # Both sides recorded the agreed valuation.
             for node in (a, b):
                 assert node.services.storage_service.validated_transactions \
@@ -162,9 +167,11 @@ def test_unilateral_valuation_rejected_at_contract_level():
     b = Party.of("B", KeyPair.generate(b"\x96" * 32).public)
     o = Party.of("O", KeyPair.generate(b"\x97" * 32).public)
     n = Party.of("N", KeyPair.generate(b"\x98" * 32).public)
+    from corda_tpu.tools.simm import IRSTrade
+
     portfolio = PortfolioState(party_a=a, party_b=b, oracle=o,
                                rate_ref=FixOf("R", 1, "1D"),
-                               notionals=(100,))
+                               trades=(IRSTrade(100_000, 250, 365),))
 
     l = ledger(n)
     with l.transaction() as tx:
