@@ -635,6 +635,8 @@ def _run_phases(report: dict) -> None:
     try:
         report["notary_roundtrip"] = bench_notary_roundtrip()
         report["notary_roundtrip_error"] = None
+    except BenchTimeout:
+        raise  # the one-shot alarm must abort the RUN, not become a phase error
     except Exception as e:  # keep the headline number even if e2e tier breaks
         report["notary_roundtrip"] = None
         report["notary_roundtrip_error"] = f"{type(e).__name__}: {e}"

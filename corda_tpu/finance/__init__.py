@@ -7,9 +7,12 @@ contract, and TwoPartyTradeFlow delivery-versus-payment.
 
 from .amount import Amount
 from .cash import Cash, CashExit, CashIssue, CashMove, CashState
+from .commodity import Commodity, CommodityContract, CommodityState
+from .on_ledger_asset import OnLedgerAsset
 from .trade import BuyerFlow, SellerFlow, SellerTradeInfo
 
 __all__ = [
     "Amount", "Cash", "CashState", "CashIssue", "CashMove", "CashExit",
+    "Commodity", "CommodityContract", "CommodityState", "OnLedgerAsset",
     "SellerFlow", "BuyerFlow", "SellerTradeInfo",
 ]

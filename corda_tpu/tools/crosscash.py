@@ -61,10 +61,13 @@ def _party_by_name(hub, name: str):
 
 
 def _notary_of(hub):
-    for info in hub.network_map_cache.party_nodes:
-        if info.advertised_services:
-            return info.legal_identity
-    raise FlowException("no notary advertised in the network map")
+    # The cache's notary_nodes predicate (service type is_sub_type_of
+    # NOTARY_TYPE) — NOT "any advertised service", which would happily
+    # pick an oracle as the notary.
+    notary = hub.network_map_cache.get_any_notary()
+    if notary is None:
+        raise FlowException("no notary advertised in the network map")
+    return notary
 
 
 @register_flow(name="crosscash.CashCommandFlow")

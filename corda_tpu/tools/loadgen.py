@@ -100,10 +100,10 @@ class _Firehose:
 
     @staticmethod
     def _find_notary(hub):
-        for info in hub.network_map_cache.party_nodes:
-            if info.advertised_services:
-                return info.legal_identity
-        raise RuntimeError("no notary advertised in the network map")
+        notary = hub.network_map_cache.get_any_notary()
+        if notary is None:
+            raise RuntimeError("no notary advertised in the network map")
+        return notary
 
     def _build_one(self, i: int):
         """Issue (recorded locally, as in NotaryDemo) + signed move."""

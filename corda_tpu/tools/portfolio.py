@@ -30,6 +30,11 @@ from ..flows.finality import FinalityFlow
 from ..flows.oracle import FixOf, RatesFixQueryFlow
 from ..serialization.codec import register
 from ..transactions.builder import TransactionBuilder
+# Codec registration: PortfolioState.trades holds simm.IRSTrade values, so
+# any process loading the portfolio cordapp must be able to (de)serialize
+# them — importing at module level registers the type (the lazy import
+# inside compute_valuation runs too late for an inbound transaction).
+from . import simm as _simm  # noqa: F401
 
 
 @register
