@@ -1,0 +1,64 @@
+"""Native runtime components (C over the CPython API), with fallbacks.
+
+The reference's runtime tiers (Kryo serialization, Artemis framing) are
+JVM bytecode the JIT compiles to machine code; the corda_tpu equivalents
+are Python, which pays an interpreter tax on the hottest per-message loops.
+This package holds C implementations of those loops — currently the codec
+decode core (`_ccodec.c`, wired in by corda_tpu/serialization/codec.py) —
+compiled on first use with the system compiler and loaded with a graceful
+pure-Python fallback, so the framework never REQUIRES a toolchain but uses
+one when present. Set CORDA_TPU_NO_NATIVE=1 to force the Python paths
+(conformance tests run both).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sysconfig
+import tempfile
+
+
+def load_ccodec():
+    """Import the native codec core, building it on first use. Returns the
+    module or None (no compiler, build failure, or CORDA_TPU_NO_NATIVE)."""
+    if os.environ.get("CORDA_TPU_NO_NATIVE"):
+        return None
+    try:
+        from . import _ccodec  # already built
+
+        return _ccodec
+    except ImportError:
+        pass
+    src = pathlib.Path(__file__).with_name("_ccodec.c")
+    if not src.exists():
+        return None
+    ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    target = src.with_name("_ccodec" + ext_suffix)
+    include = sysconfig.get_paths()["include"]
+    # Build to a temp name and os.replace (atomic) so concurrent builders
+    # (the driver spawns many node processes at once) never load a
+    # half-written .so.
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(src.parent))
+        os.close(fd)
+        subprocess.run(
+            ["gcc", "-O2", "-fPIC", "-shared", f"-I{include}",
+             str(src), "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, target)
+    except Exception:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
+    try:
+        from . import _ccodec
+
+        return _ccodec
+    except ImportError:
+        return None

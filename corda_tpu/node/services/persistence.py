@@ -179,9 +179,16 @@ class NodeDatabase:
         return _batch()
 
     def close(self) -> None:
-        self._conn.close()
+        # The aux connection is shared with bridge threads (outbox replay /
+        # ack). Closing a sqlite connection while another thread is inside
+        # an execute on it is a C-level crash, not an exception — so take
+        # the same aux_lock every aux user holds; after close they get a
+        # python-level ProgrammingError, which the bridge loop treats as
+        # node shutdown.
         if self._aux_conn is not None:
-            self._aux_conn.close()
+            with self.aux_lock:
+                self._aux_conn.close()
+        self._conn.close()
 
     def get_setting(self, key: str) -> str | None:
         row = self._conn.execute(

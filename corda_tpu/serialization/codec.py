@@ -115,6 +115,13 @@ def register(cls: Type[T]) -> Type[T]:
     return register_class(cls)
 
 
+# Resolved lazily on the first object encode (.tokens imports this module,
+# so a top-level import would be circular); a per-call `from .tokens import`
+# in the encode hot path showed up in profiles at firehose load.
+SerializeAsToken = None
+current_token_context = None
+
+
 def _write_varint(out: bytearray, n: int) -> None:
     if n < 0:
         raise ValueError("varint must be non-negative")
@@ -214,51 +221,14 @@ def _encode(out: bytearray, value: Any) -> None:
         for e in encs:
             out.extend(e)
     else:
-        from .tokens import SerializeAsToken, current_token_context
-
-        if isinstance(value, SerializeAsToken):
-            # Long-lived services become named tokens in checkpoints
-            # (reference: SerializationToken.kt:25-133). Valid only inside an
-            # active TokenContext.
-            ctx = current_token_context()
-            if ctx is None:
-                raise TypeError(
-                    f"{type(value).__qualname__} is a service token; it can only be "
-                    "serialized inside a checkpoint TokenContext"
-                )
-            out.append(_TAG_OBJECT)
-            raw = b"__svc_token__"
-            _write_varint(out, len(raw))
-            out.extend(raw)
-            _write_varint(out, 1)
-            _encode(out, value.token_name)
+        # ONE semantic authority for the object branch (_object_parts):
+        # registry/whitelist, service tokens, custom encoders and the memo
+        # all live there, shared with the native encoder's callback.
+        parts = _object_parts(value)
+        if isinstance(parts, bytes):  # memo hit / pre-encoded token
+            out.extend(parts)
             return
-        cls = type(value)
-        cacheable = cls in _CACHEABLE
-        if cacheable:
-            # getattr, not value.__dict__: a __slots__ class has no instance
-            # dict and must skip the memo on the read side too (the write
-            # side already guards; round-3 advisor).
-            cached = getattr(value, "_codec_enc", None)
-            if cached is not None:
-                out.extend(cached)
-                return
-        plan = _ENC_PLAN.get(cls)
-        if plan is None:
-            wire_name = _BY_TYPE.get(cls)
-            if wire_name is None:
-                raise TypeError(
-                    f"type {cls.__qualname__} is not registered for serialization")
-            name_raw = wire_name.encode("utf-8")
-            names = (() if cls in _CUSTOM_ENC else
-                     tuple(f.name for f in dataclasses.fields(cls)))
-            plan = _ENC_PLAN[cls] = (name_raw, names)
-        name_raw, names = plan
-        enc = _CUSTOM_ENC.get(cls)
-        if enc is not None:
-            fields = tuple(enc(value))
-        else:
-            fields = tuple(getattr(value, n) for n in names)
+        name_raw, fields, cacheable = parts
         start = len(out)
         out.append(_TAG_OBJECT)
         _write_varint(out, len(name_raw))
@@ -267,10 +237,7 @@ def _encode(out: bytearray, value: Any) -> None:
         for f in fields:
             _encode(out, f)
         if cacheable:
-            try:
-                object.__setattr__(value, "_codec_enc", bytes(out[start:]))
-            except AttributeError:
-                pass  # __slots__ types simply skip the memo
+            _memo_store(value, bytes(out[start:]))
 
 
 _MAX_DEPTH = 64  # hostile nesting must exhaust this, not the Python stack
@@ -377,64 +344,73 @@ def _decode(data: bytes, pos: int, depth: int = 0) -> tuple[Any, int]:
         except UnicodeDecodeError as e:
             raise DeserializationError(f"invalid wire name: {e}") from e
         pos += n
-        if wire_name == "__svc_token__":
-            from .tokens import current_token_context
-
-            nfields, pos = _read_varint(data, pos)
-            if nfields != 1:
-                raise DeserializationError("malformed service token")
-            token_name, pos = _decode(data, pos, depth + 1)
-            if not isinstance(token_name, str):
-                # An unhashable/wrong-typed name must reject, not TypeError
-                # out of the registry lookup.
-                raise DeserializationError("service token name must be a string")
-            ctx = current_token_context()
-            if ctx is None:
-                raise DeserializationError(
-                    f"service token {token_name!r} outside a TokenContext"
-                )
-            try:
-                return ctx.resolve(token_name), pos
-            except KeyError as e:
-                raise DeserializationError(str(e)) from e
-        cls = _BY_NAME.get(wire_name)
-        if cls is None:
-            raise DeserializationError(f"type {wire_name!r} is not whitelisted")
         nfields, pos = _read_varint(data, pos)
+        if nfields > len(data) - pos:
+            raise DeserializationError("collection count exceeds data")
         values = []
         for _ in range(nfields):
             v, pos = _decode(data, pos, depth + 1)
             values.append(v)
-        dec = _CUSTOM_DEC.get(wire_name)
-        if dec is not None:
-            try:
-                return dec(tuple(values)), pos
-            except Exception as e:  # malformed payloads must not crash callers
-                raise DeserializationError(
-                    f"cannot decode {wire_name}: {e}") from e
-        plan = _DEC_PLAN.get(wire_name)
-        if plan is None:
-            plan = _DEC_PLAN[wire_name] = (cls, tuple(
-                (f.name, str(f.type).startswith(("list", "List")))
-                for f in dataclasses.fields(cls)))
-        _, field_plan = plan
-        if len(values) != len(field_plan):
-            raise DeserializationError(
-                f"{wire_name}: expected {len(field_plan)} fields, "
-                f"got {len(values)}"
-            )
-        kwargs = {}
-        for (fname, is_list), v in zip(field_plan, values):
-            # Tuples are the wire form of all sequences; convert back per the
-            # declared field so list-typed fields round-trip.
-            if is_list and isinstance(v, tuple):
-                v = list(v)
-            kwargs[fname] = v
-        try:
-            return cls(**kwargs), pos
-        except Exception as e:  # malformed payloads must not crash callers
-            raise DeserializationError(f"cannot construct {wire_name}: {e}") from e
+        return _construct(wire_name, tuple(values)), pos
     raise DeserializationError(f"unknown tag 0x{tag:02x}")
+
+
+def _construct(wire_name: str, values: tuple) -> Any:
+    """Registry lookup + construction for a decoded object — shared by the
+    pure-Python decoder above and the native decode core (which decodes the
+    wire structure in C and calls back here, so the whitelist and
+    construction semantics live in exactly one place)."""
+    if wire_name == "__svc_token__":
+        from .tokens import current_token_context
+
+        if len(values) != 1:
+            raise DeserializationError("malformed service token")
+        token_name = values[0]
+        if not isinstance(token_name, str):
+            # An unhashable/wrong-typed name must reject, not TypeError
+            # out of the registry lookup.
+            raise DeserializationError("service token name must be a string")
+        ctx = current_token_context()
+        if ctx is None:
+            raise DeserializationError(
+                f"service token {token_name!r} outside a TokenContext"
+            )
+        try:
+            return ctx.resolve(token_name)
+        except KeyError as e:
+            raise DeserializationError(str(e)) from e
+    cls = _BY_NAME.get(wire_name)
+    if cls is None:
+        raise DeserializationError(f"type {wire_name!r} is not whitelisted")
+    dec = _CUSTOM_DEC.get(wire_name)
+    if dec is not None:
+        try:
+            return dec(values)
+        except Exception as e:  # malformed payloads must not crash callers
+            raise DeserializationError(
+                f"cannot decode {wire_name}: {e}") from e
+    plan = _DEC_PLAN.get(wire_name)
+    if plan is None:
+        plan = _DEC_PLAN[wire_name] = (cls, tuple(
+            (f.name, str(f.type).startswith(("list", "List")))
+            for f in dataclasses.fields(cls)))
+    _, field_plan = plan
+    if len(values) != len(field_plan):
+        raise DeserializationError(
+            f"{wire_name}: expected {len(field_plan)} fields, "
+            f"got {len(values)}"
+        )
+    kwargs = {}
+    for (fname, is_list), v in zip(field_plan, values):
+        # Tuples are the wire form of all sequences; convert back per the
+        # declared field so list-typed fields round-trip.
+        if is_list and isinstance(v, tuple):
+            v = list(v)
+        kwargs[fname] = v
+    try:
+        return cls(**kwargs)
+    except Exception as e:  # malformed payloads must not crash callers
+        raise DeserializationError(f"cannot construct {wire_name}: {e}") from e
 
 
 @dataclasses.dataclass(frozen=True)
@@ -457,17 +433,116 @@ class SerializedBytes:
 
 
 def serialize(value: Any) -> SerializedBytes:
+    if _ccodec is not None:
+        return SerializedBytes(_ccodec.encode(value))
     out = bytearray()
     _encode(out, value)
     return SerializedBytes(bytes(out))
 
 
+def _object_parts(value: Any):
+    """The object branch's single semantic authority, shared by the pure
+    encoder (_encode's tail) and the native encoder's callback. Returns
+    bytes to splice verbatim (memo hits, service tokens, wide integers the
+    C core punts on) OR (wire_name_bytes, fields_tuple, memoize_bool) for
+    the caller to encode."""
+    if isinstance(value, (int, float)):  # wide-int fallback from C
+        out = bytearray()
+        _encode(out, value)
+        return bytes(out)
+    global SerializeAsToken, current_token_context
+    if SerializeAsToken is None:  # lazy: .tokens imports this module
+        from .tokens import SerializeAsToken, current_token_context
+    if isinstance(value, SerializeAsToken):
+        # Long-lived services become named tokens in checkpoints
+        # (reference: SerializationToken.kt:25-133). Valid only inside an
+        # active TokenContext. Encoded directly here (NOT via _encode,
+        # whose object tail would recurse back into this function).
+        ctx = current_token_context()
+        if ctx is None:
+            raise TypeError(
+                f"{type(value).__qualname__} is a service token; it can "
+                "only be serialized inside a checkpoint TokenContext"
+            )
+        out = bytearray()
+        out.append(_TAG_OBJECT)
+        raw = b"__svc_token__"
+        _write_varint(out, len(raw))
+        out.extend(raw)
+        _write_varint(out, 1)
+        _encode(out, value.token_name)
+        return bytes(out)
+    cls = type(value)
+    cacheable = cls in _CACHEABLE
+    if cacheable:
+        # getattr, not value.__dict__: a __slots__ class has no instance
+        # dict and must skip the memo on the read side too (the write
+        # side already guards; round-3 advisor).
+        cached = getattr(value, "_codec_enc", None)
+        if cached is not None:
+            return cached
+    plan = _ENC_PLAN.get(cls)
+    if plan is None:
+        wire_name = _BY_TYPE.get(cls)
+        if wire_name is None:
+            raise TypeError(
+                f"type {cls.__qualname__} is not registered for serialization")
+        name_raw = wire_name.encode("utf-8")
+        names = (() if cls in _CUSTOM_ENC else
+                 tuple(f.name for f in dataclasses.fields(cls)))
+        plan = _ENC_PLAN[cls] = (name_raw, names)
+    name_raw, names = plan
+    enc = _CUSTOM_ENC.get(cls)
+    if enc is not None:
+        fields = tuple(enc(value))
+    else:
+        fields = tuple(getattr(value, n) for n in names)
+    return (name_raw, fields, cacheable)
+
+
+def _memo_store(value: Any, enc: bytes) -> None:
+    try:
+        object.__setattr__(value, "_codec_enc", enc)
+    except AttributeError:
+        pass  # __slots__ types simply skip the memo
+
+
 def deserialize(data: bytes | SerializedBytes) -> Any:
     raw = data.bytes if isinstance(data, SerializedBytes) else data
+    if _ccodec is not None:
+        return _ccodec.decode(raw)
     value, pos = _decode(raw, 0)
     if pos != len(raw):
         raise DeserializationError(f"{len(raw) - pos} trailing bytes")
     return value
+
+
+# Native decode core (corda_tpu/native/_ccodec.c): decodes the wire
+# structure in C and calls _construct for objects. Loaded lazily with a
+# silent fallback — the pure-Python decoder above stays the semantic
+# authority, and the conformance suite runs both against the same corpus.
+_ccodec = None
+
+
+def _load_native() -> bool:
+    """Try to enable the native decode core; True if active."""
+    global _ccodec
+    if _ccodec is not None:
+        return True
+    try:
+        from ..native import load_ccodec
+
+        module = load_ccodec()
+    except Exception:
+        return False
+    if module is None:
+        return False
+    module.init(DeserializationError, _construct, _object_parts, _memo_store)
+    _ccodec = module
+    return True
+
+
+_load_native()
 
 
 def serialized_hash(value: Any):

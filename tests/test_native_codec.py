@@ -1,0 +1,112 @@
+"""Native decode core vs the pure-Python decoder: bit-for-bit conformance.
+
+The C decoder (corda_tpu/native/_ccodec.c) must accept exactly what the
+Python decoder accepts (same values) and reject exactly what it rejects
+(DeserializationError both sides) — on round-tripped values AND on
+adversarial mutated byte strings.
+"""
+
+import random
+
+import pytest
+
+from corda_tpu.serialization import codec
+
+pytestmark = pytest.mark.skipif(
+    not codec._load_native(), reason="native codec unavailable (no gcc?)")
+
+
+def _decode_py(raw: bytes):
+    value, pos = codec._decode(raw, 0)
+    if pos != len(raw):
+        raise codec.DeserializationError("trailing")
+    return value
+
+
+def _decode_c(raw: bytes):
+    return codec._ccodec.decode(raw)
+
+
+def _corpus():
+    from corda_tpu.crypto.hashes import SecureHash
+    from corda_tpu.crypto.keys import KeyPair
+    from corda_tpu.testing.dummies import DummyContract
+
+    kp = KeyPair.generate(b"\x42" * 32)
+    notary_kp = KeyPair.generate(b"\x43" * 32)
+    from corda_tpu.crypto.party import Party
+
+    party = Party.of("P", kp.public)
+    notary = Party.of("N", notary_kp.public)
+    builder = DummyContract.generate_initial(party.ref(b"\x01"), 7, notary)
+    builder.sign_with(kp)
+    stx = builder.to_signed_transaction(check_sufficient_signatures=False)
+    return [
+        None, True, False, 0, 1, -1, 63, 64, -64, -65, 2**63, -(2**63),
+        2**255 - 19, -(2**200), 0.0, 1.5, -2.25, 1e300,
+        b"", b"\x00" * 33, "", "ascii", "unié中",
+        (), (1, (2, (3, (4,)))), {"a": 1, "zz": {"n": ()}},
+        frozenset(), frozenset({1, "x", b"y"}),
+        SecureHash.sha256(b"leaf"), party, stx,
+    ]
+
+
+def test_values_agree():
+    for v in _corpus():
+        raw = codec.serialize(v).bytes
+        assert _decode_c(raw) == _decode_py(raw) == v
+
+
+def test_mutation_fuzz_agreement():
+    # Mutate real encodings; the two decoders must agree on accept/reject
+    # AND on the decoded value when both accept.
+    rng = random.Random(11)
+    corpus = [codec.serialize(v).bytes for v in _corpus()]
+    checked = 0
+    for raw in corpus:
+        for _ in range(40):
+            buf = bytearray(raw)
+            op = rng.randrange(3)
+            if op == 0 and buf:
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+            elif op == 1 and len(buf) > 1:
+                del buf[rng.randrange(len(buf))]
+            else:
+                buf.insert(rng.randrange(len(buf) + 1), rng.randrange(256))
+            mutated = bytes(buf)
+            try:
+                py_val = _decode_py(mutated)
+                py_err = None
+            except codec.DeserializationError:
+                py_val, py_err = None, True
+            try:
+                c_val = _decode_c(mutated)
+                c_err = None
+            except codec.DeserializationError:
+                c_val, c_err = None, True
+            assert py_err == c_err, mutated.hex()
+            if py_err is None:
+                assert py_val == c_val, mutated.hex()
+            checked += 1
+    assert checked >= 1000
+
+
+def test_truncation_sweep_agreement():
+    for v in _corpus():
+        raw = codec.serialize(v).bytes
+        for cut in range(len(raw)):
+            prefix = raw[:cut]
+            with pytest.raises(codec.DeserializationError):
+                _decode_py(prefix)
+            with pytest.raises(codec.DeserializationError):
+                _decode_c(prefix)
+
+
+def test_deep_nesting_rejected_both():
+    raw = codec.serialize(1).bytes
+    for _ in range(70):  # > _MAX_DEPTH
+        raw = bytes([0x06, 0x01]) + raw  # list of one
+    with pytest.raises(codec.DeserializationError, match="deep"):
+        _decode_py(raw)
+    with pytest.raises(codec.DeserializationError, match="deep"):
+        _decode_c(raw)
