@@ -4,15 +4,17 @@ The reference's runtime tiers (Kryo serialization, Artemis framing) are
 JVM bytecode the JIT compiles to machine code; the corda_tpu equivalents
 are Python, which pays an interpreter tax on the hottest per-message loops.
 This package holds C implementations of those loops — currently the codec
-decode core (`_ccodec.c`, wired in by corda_tpu/serialization/codec.py) —
-compiled on first use with the system compiler and loaded with a graceful
-pure-Python fallback, so the framework never REQUIRES a toolchain but uses
-one when present. Set CORDA_TPU_NO_NATIVE=1 to force the Python paths
-(conformance tests run both).
+decode/encode core (`_ccodec.c`, wired in by corda_tpu/serialization/
+codec.py) — compiled on first use with the system compiler and loaded with
+a graceful pure-Python fallback, so the framework never REQUIRES a
+toolchain but uses one when present. Set CORDA_TPU_NO_NATIVE=1 to force
+the Python paths (conformance tests run both).
 """
 
 from __future__ import annotations
 
+import hashlib
+import importlib
 import os
 import pathlib
 import subprocess
@@ -20,26 +22,43 @@ import sysconfig
 import tempfile
 
 
+def _src_digest(src: pathlib.Path) -> str:
+    return hashlib.sha256(src.read_bytes()).hexdigest()
+
+
 def load_ccodec():
     """Import the native codec core, building it on first use. Returns the
-    module or None (no compiler, build failure, or CORDA_TPU_NO_NATIVE)."""
+    module or None (no compiler, build failure, or CORDA_TPU_NO_NATIVE).
+
+    Freshness: the wire format is consensus-critical, so a stale build must
+    never shadow an updated `_ccodec.c` — the built .so carries a sidecar
+    recording the source sha256, and any mismatch triggers a rebuild.
+    """
     if os.environ.get("CORDA_TPU_NO_NATIVE"):
         return None
-    try:
-        from . import _ccodec  # already built
-
-        return _ccodec
-    except ImportError:
-        pass
     src = pathlib.Path(__file__).with_name("_ccodec.c")
     if not src.exists():
         return None
     ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     target = src.with_name("_ccodec" + ext_suffix)
-    include = sysconfig.get_paths()["include"]
+    stamp = src.with_name("_ccodec.src-sha256")
+    digest = _src_digest(src)
+    if target.exists():
+        try:
+            fresh = stamp.read_text().strip() == digest
+        except OSError:
+            fresh = False
+        if fresh:
+            try:
+                from . import _ccodec
+
+                return _ccodec
+            except ImportError:
+                pass  # broken artifact: rebuild below
     # Build to a temp name and os.replace (atomic) so concurrent builders
     # (the driver spawns many node processes at once) never load a
     # half-written .so.
+    include = sysconfig.get_paths()["include"]
     tmp = None
     try:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(src.parent))
@@ -49,6 +68,7 @@ def load_ccodec():
              str(src), "-o", tmp],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, target)
+        stamp.write_text(digest + "\n")
     except Exception:
         if tmp is not None:
             try:
@@ -56,6 +76,10 @@ def load_ccodec():
             except OSError:
                 pass
         return None
+    # The failed import above may have cached the directory listing from
+    # before the .so existed; without invalidation the fresh build can be
+    # invisible to this process (1s-mtime filesystems).
+    importlib.invalidate_caches()
     try:
         from . import _ccodec
 

@@ -187,6 +187,15 @@ class Node:
             .all_transactions()
         if stored:
             self.services.vault_service.notify_all(stored)
+        # Vault updates join the change feed so RPC push subscribers
+        # (explorer) stream ledger activity live, alongside flow events
+        # (the reference pushes vaultAndUpdates the same way,
+        # CordaRPCOps.kt:71-76). Subscribed AFTER the rebuild replay above:
+        # a restart must not re-emit the whole stored ledger as fresh
+        # events to reconnecting push clients.
+        self.services.vault_service.subscribe(
+            lambda update: self.smm.changes.append(
+                ("vault", len(update.consumed), len(update.produced))))
         from .services.scheduler import NodeSchedulerService
         from .services.vault_observers import CashBalanceMetricsObserver
 

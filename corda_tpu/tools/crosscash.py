@@ -328,3 +328,34 @@ def run_crosscash(
         commands_rejected=n_rej, converged=converged,
         disruptions=disruptions,
         expected=dict(model.balances), gathered=gathered)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="CrossCash: random cash traffic + convergence checking")
+    ap.add_argument("--waves", type=int, default=4)
+    ap.add_argument("--wave-size", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--notary", choices=("simple", "validating", "raft"),
+                    default="raft")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--disrupt", action="append", default=None,
+                    choices=("kill-follower", "sigstop-follower",
+                             "strain-follower"),
+                    help="repeatable; one disruption per successive wave")
+    args = ap.parse_args(argv)
+    result = run_crosscash(
+        n_waves=args.waves, wave_size=args.wave_size, clients=args.clients,
+        notary=args.notary, seed=args.seed,
+        disrupt=tuple(args.disrupt) if args.disrupt else None)
+    print(json.dumps(result.__dict__))
+    return 0 if result.converged else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -51,10 +51,26 @@ def _corpus():
     ]
 
 
+def _encode_py(v) -> bytes:
+    out = bytearray()
+    codec._encode(out, v)
+    return bytes(out)
+
+
 def test_values_agree():
     for v in _corpus():
-        raw = codec.serialize(v).bytes
-        assert _decode_c(raw) == _decode_py(raw) == v
+        # Encode parity must hold BYTE-FOR-BYTE (not merely "both decoders
+        # accept it"): encoded bytes feed Merkle ids, so a native/pure
+        # divergence would split tx identity between nodes.
+        c_raw = codec._ccodec.encode(v)
+        # memoized types cache their encoding on first serialize; clear so
+        # the pure encoder genuinely re-encodes rather than splicing the
+        # native bytes back.
+        if getattr(v, "_codec_enc", None) is not None:
+            object.__setattr__(v, "_codec_enc", None)
+        py_raw = _encode_py(v)
+        assert c_raw == py_raw, type(v)
+        assert _decode_c(c_raw) == _decode_py(c_raw) == v
 
 
 def test_mutation_fuzz_agreement():

@@ -191,3 +191,28 @@ def test_eviction_gap_is_detected_not_silent(live_node):
         assert client.push_gaps[sid] == 98  # 99 - 1 missing events counted
     finally:
         client.close()
+
+
+def test_vault_updates_ride_the_push_stream(live_node):
+    # The reference pushes vaultAndUpdates over RPC (CordaRPCOps.kt:71-76);
+    # here vault updates join the same pushed change feed flow events use.
+    from corda_tpu.finance import Amount
+    from corda_tpu.finance.cash import Cash
+
+    client = RpcClient(live_node.messaging.my_address, "ops", "pw")
+    try:
+        got: list = []
+        client.subscribe_changes(lambda events, cursor: got.extend(events))
+        builder = Cash.generate_issue(
+            Amount(5_000, "USD"), live_node.identity.ref(b"\x01"),
+            live_node.identity.owning_key, live_node.identity)
+        builder.sign_with(live_node.key)
+        stx = builder.to_signed_transaction()
+        live_node.services.record_transactions([stx])
+        assert _wait(
+            lambda: any(e[0] == "vault" for e in got), client=client), got
+        vault_events = [e for e in got if e[0] == "vault"]
+        assert vault_events[0][1] == 0   # nothing consumed by an issue
+        assert vault_events[0][2] == 1   # one state produced
+    finally:
+        client.close()
