@@ -71,28 +71,47 @@ def _time_median(fn, repeats=5):
     return statistics.median(times)
 
 
-def warm_buckets(pks, msgs, sigs):
-    """Compile BOTH verify backends (Pallas kernel AND the plain-XLA
-    fallback graph) plus the device-hash route at every bucket, outside any
+def warm_buckets(pks, msgs, sigs, fallback_budget_s=600.0):
+    """Compile the PRIMARY verify backends (Pallas kernel + device-hash
+    route) at every bucket, then the plain-XLA fallback graphs, outside any
     timed region. Two reasons: (a) a mid-timing Pallas transient must fall
     back to an ALREADY-COMPILED XLA graph, not pay a multi-minute compile
     inside the measurement (that pollution is what round 3's 6.9k "XLA"
     numbers were); (b) the persistent compile cache gets populated so later
-    runs start warm."""
+    runs start warm.
+
+    The fallback graphs are warmed under a time budget: on a cold compile
+    cache each one costs minutes (r03: 3-5 min/bucket) and they serve ONLY
+    the failure path — the watchdog must not be eaten by insurance."""
+    import sys
+    import time as _time
+
     import jax
 
     from corda_tpu.ops import ed25519_jax
 
+    staged = []
     for bucket in BUCKETS:
         bp, bm, bs = tile(pks, bucket), tile(msgs, bucket), tile(sigs, bucket)
         arrays, _ = ed25519_jax.precompute_batch(bp, bm, bs, bucket=bucket)
         arrays = jax.device_put(arrays)
-        ed25519_jax.verify_arrays(*arrays).block_until_ready()  # XLA graph
         ed25519_jax.verify_arrays_auto(*arrays).block_until_ready()
         darrays, _ = ed25519_jax.precompute_batch_device(bp, bm, bs,
                                                          bucket=bucket)
         np.asarray(ed25519_jax.verify_arrays_hashed(*darrays))
-        del arrays, darrays
+        del darrays
+        staged.append((bucket, arrays))
+    # Largest bucket first: the budget buys the most expensive insurance
+    # (and the headline 64k measurement's fallback) before the cheap ones.
+    t0 = _time.monotonic()
+    for bucket, arrays in reversed(staged):
+        if _time.monotonic() - t0 > fallback_budget_s:
+            print(f"warm_buckets: fallback warm budget exhausted before "
+                  f"bucket {bucket}; a mid-run Pallas failure there would "
+                  f"pay its XLA compile in-measurement", file=sys.stderr)
+            break
+        ed25519_jax.verify_arrays(*arrays).block_until_ready()  # XLA graph
+    staged.clear()
 
 
 def bench_kernel(pks, msgs, sigs, valid):

@@ -135,22 +135,30 @@ class _Outbox:
             return {p for _, p, _, _ in self._mem}
 
     def ack(self, unique_id: bytes) -> None:
+        self.ack_many((unique_id,))
+
+    def ack_many(self, unique_ids) -> None:
+        """Retire a batch of delivered frames in ONE sqlite transaction —
+        the receiver coalesces a round's ACKs into one frame, and a commit
+        per id was the bridge side's hottest sqlite call."""
         if self._db is not None:
             import sqlite3
 
             try:
                 with self._db.aux_lock:
-                    self._db.aux_conn.execute(
-                        "DELETE FROM outbox WHERE unique_id = ?", (unique_id,))
+                    self._db.aux_conn.executemany(
+                        "DELETE FROM outbox WHERE unique_id = ?",
+                        [(u,) for u in unique_ids])
                     self._db.aux_conn.commit()
             except sqlite3.OperationalError:
                 # Write lock held past busy_timeout (an unusually long node
-                # round): leave the row; the replay loop redelivers and the
-                # receiver's dedupe + re-ACK retire it next pass.
+                # round): leave the rows; the replay loop redelivers and the
+                # receiver's dedupe + re-ACK retire them next pass.
                 pass
         else:
+            drop = set(unique_ids)
             with self._lock:
-                self._mem = [e for e in self._mem if e[2] != unique_id]
+                self._mem = [e for e in self._mem if e[2] not in drop]
 
 
 class _Dedupe:
@@ -449,11 +457,10 @@ class TcpMessaging(MessagingService):
                         and decoded[0] == "acks"
                         and isinstance(decoded[1], tuple)):
                     # Round-coalesced ACK frame (one per connection per
-                    # receiver round).
-                    for unique_id in decoded[1]:
-                        if isinstance(unique_id, bytes):
-                            self._outbox.ack(unique_id)
-                            sent.discard(unique_id)
+                    # receiver round): retired in one sqlite transaction.
+                    ids = [u for u in decoded[1] if isinstance(u, bytes)]
+                    self._outbox.ack_many(ids)
+                    sent.difference_update(ids)
                 idle_polls = 0
             except socket.timeout:
                 idle_polls += 1
