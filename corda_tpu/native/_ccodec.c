@@ -88,13 +88,21 @@ read_varint(const unsigned char *data, Py_ssize_t len, Py_ssize_t pos,
         shift += 7;
     }
 
-    /* Slow path: rebuild from the bytes with PyLong arithmetic. */
+    /* Slow path: rebuild from the bytes with PyLong arithmetic. The fast
+     * loop only breaks here after SEEING the terminator in-bounds, so the
+     * re-scan is bounded — the explicit check documents (and enforces)
+     * that invariant. */
     {
         PyObject *result = PyLong_FromLong(0);
         if (result == NULL)
             return -1;
         int sh = 0;
         for (Py_ssize_t i = start;; i++) {
+            if (i >= len) {
+                Py_DECREF(result);
+                raise_deser("truncated varint");
+                return -1;
+            }
             unsigned char b = data[i];
             PyObject *group = PyLong_FromUnsignedLong(b & 0x7F);
             PyObject *shn = PyLong_FromLong(sh);

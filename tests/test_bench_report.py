@@ -11,14 +11,20 @@ import bench
 
 
 def _stub_phases(monkeypatch):
+    # Never run the real subprocess device probe in tests: on a host with a
+    # wedged accelerator tunnel it burns its full timeout per call.
+    monkeypatch.setattr(bench, "_device_reachable", lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_device_init_with_timeout",
+                        lambda *a, **k: "stub-device")
     monkeypatch.setattr(bench, "_warm_verify_kernel", lambda: None)
     monkeypatch.setattr(bench, "warm_buckets", lambda *a: None)
     monkeypatch.setattr(bench, "bench_notary_roundtrip",
-                        lambda: {"tx_per_sec": 100.0})
+                        lambda **kw: {"tx_per_sec": 100.0})
     for name in ("bench_raft_cluster", "bench_open_loop_latency",
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
-        monkeypatch.setattr(bench, name, lambda n=name: {"stub": n})
+        monkeypatch.setattr(bench, name,
+                    lambda *a, n=name, **kw: {"stub": n})
     monkeypatch.setattr(
         bench, "bench_kernel",
         lambda *a: ({4096: 1000.0}, {4096: 800.0}, {4096: 900.0},
@@ -31,7 +37,7 @@ def _stub_phases(monkeypatch):
 
 def test_report_is_one_json_line(monkeypatch, capsys):
     _stub_phases(monkeypatch)
-    monkeypatch.setattr(bench, "_install_watchdog", lambda s: None)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
@@ -50,7 +56,7 @@ def test_report_is_one_json_line(monkeypatch, capsys):
 
 def test_watchdog_timeout_still_prints_partial_report(monkeypatch, capsys):
     _stub_phases(monkeypatch)
-    monkeypatch.setattr(bench, "_install_watchdog", lambda s: None)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
 
     def wedge(*a):
         raise bench.BenchTimeout("bench watchdog fired after 1s")
@@ -65,3 +71,32 @@ def test_watchdog_timeout_still_prints_partial_report(monkeypatch, capsys):
     assert report["baseline_configs"]["flow_churn"] == {
         "stub": "bench_flow_churn"}
     assert report["value"] == 0.0  # headline never computed: honest zero
+
+
+def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
+    # When the accelerator is unreachable (wedged tunnel), bench must still
+    # measure every host-side config instead of producing nothing.
+    _stub_phases(monkeypatch)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
+    monkeypatch.setattr(bench, "_device_reachable", lambda *a, **k: False)
+    monkeypatch.setattr(bench, "make_corpus",
+                        lambda *a: ([b"pk"], [b"m"], [b"s"], [True]))
+    bench.main()
+    report = json.loads(capsys.readouterr().out.strip())
+    assert "accelerator unreachable" in report["error"]
+    assert report["device"] == "unavailable"
+    assert report["value"] == 0.0
+    assert report["baseline_configs"]["raft_notary_3node"] == {
+        "stub": "bench_raft_cluster"}
+    assert report["baseline_configs"]["flow_churn"] == {
+        "stub": "bench_flow_churn"}
+    # The verifier-parameterized configs must have run WITH their kwargs
+    # (a stub signature mismatch would silently exercise only error paths).
+    assert report["notary_roundtrip"] == {"tx_per_sec": 100.0}
+    assert report["baseline_configs"]["trader_dvp"] == {
+        "stub": "bench_trades"}
+    assert report["baseline_configs"]["composite_3of3"] == {
+        "stub": "bench_multisig"}
+    assert report["baseline_configs"]["resolve_ids"] == {
+        "stub": "bench_resolve_ids"}
+    assert report["cpu_oracle_sigs_per_sec"] == 250.0
