@@ -620,6 +620,8 @@ def _install_watchdog(seconds: int, report: dict):
 
     def backstop():
         time.sleep(seconds + 60)
+        if _printed:
+            return  # run completed; never kill a host process post-hoc
         hard = (f"bench hard-watchdog: unresponsive after {seconds + 60}s "
                 f"(uninterruptible hang)")
         # Snapshot under the print lock; a concurrently-mutating report can
@@ -716,26 +718,6 @@ def main():
     _print_report_once(report)
 
 
-def _device_reachable(timeout_s: float = 90.0) -> bool:
-    """Probe accelerator liveness in a SUBPROCESS: a wedged tunnel hangs
-    device init (on this host even interpreter start, via sitecustomize),
-    so the probe must be killable. Observed 2026-07-30: the axon relay
-    stopped answering for hours — without this gate the whole bench died
-    at `jax.devices()` with nothing to show."""
-    import subprocess
-    import sys
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-    except Exception:
-        return False
-
-
 def _run_host_only_phases(report: dict) -> None:
     """Degraded mode: the accelerator is unreachable, but the framework
     configs are host-side — measure everything that can be measured
@@ -778,10 +760,6 @@ def _run_host_only_phases(report: dict) -> None:
 
 
 def _run_phases(report: dict) -> None:
-    if not _device_reachable():
-        _run_host_only_phases(report)
-        return
-
     import jax
 
     # Persistent compilation cache: the kernel zoo (per-bucket Ed25519 +

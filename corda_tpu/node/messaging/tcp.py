@@ -72,6 +72,7 @@ class _Outbox:
         self._db = db
         self._mem: list[tuple[int, str, bytes, bytes]] = []
         self._mem_seq = 0
+        self._retired: list[bytes] = []  # ACKed ids awaiting node-thread delete
         self._lock = threading.Lock()
 
     def append(self, peer: str, unique_id: bytes, frame: bytes) -> None:
@@ -87,13 +88,17 @@ class _Outbox:
                 self._mem.append((self._mem_seq, peer, unique_id, frame))
 
     def pending(self, peer: str) -> list[tuple[int, bytes, bytes]]:
-        """[(seq, unique_id, frame)] in order for one peer."""
+        """[(seq, unique_id, frame)] in order for one peer (rows already
+        ACK-retired but not yet deleted by the node thread are excluded)."""
         if self._db is not None:
+            with self._lock:
+                retired = set(self._retired)
             with self._db.aux_lock:
                 rows = self._db.aux_conn.execute(
                     "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
                     "ORDER BY seq", (peer,)).fetchall()
-            return [(s, bytes(u), bytes(b)) for s, u, b in rows]
+            return [(s, bytes(u), bytes(b)) for s, u, b in rows
+                    if bytes(u) not in retired]
         with self._lock:
             return [(s, u, f) for s, p, u, f in self._mem if p == peer]
 
@@ -103,19 +108,24 @@ class _Outbox:
         the replay loop polls this every 200 ms, and re-materialising the
         whole backlog each poll was O(backlog) of blob copies per peer."""
         if self._db is not None:
+            with self._lock:
+                retired = set(self._retired)
             with self._db.aux_lock:
                 rows = self._db.aux_conn.execute(
                     "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
                     "AND seq > ? ORDER BY seq LIMIT ?",
                     (peer, after_seq, limit)).fetchall()
-            return [(s, bytes(u), bytes(b)) for s, u, b in rows]
+            return [(s, bytes(u), bytes(b)) for s, u, b in rows
+                    if bytes(u) not in retired]
         with self._lock:
             return [(s, u, f) for s, p, u, f in self._mem
                     if p == peer and s > after_seq][:limit]
 
     def count(self, peer: str) -> int:
         """Pending-frame count WITHOUT materialising blobs (polled per
-        heartbeat by consensus backpressure)."""
+        heartbeat by consensus backpressure). May briefly overcount by the
+        ACK-retired rows awaiting the node thread's delete — harmless for
+        a thresholded backpressure signal."""
         if self._db is not None:
             with self._db.aux_lock:
                 (n,) = self._db.aux_conn.execute(
@@ -138,27 +148,48 @@ class _Outbox:
         self.ack_many((unique_id,))
 
     def ack_many(self, unique_ids) -> None:
-        """Retire a batch of delivered frames in ONE sqlite transaction —
-        the receiver coalesces a round's ACKs into one frame, and a commit
-        per id was the bridge side's hottest sqlite call."""
+        """Retire delivered frames. Durable mode NEVER writes sqlite from
+        the calling (bridge) thread: a second writer connection fighting
+        the node thread's round transactions drove sqlite into busy-retry
+        episodes that starved the bridges' own reads — the observed
+        permanent one-directional delivery stalls under election churn.
+        Ids queue here and the NODE thread deletes them in flush_retired()
+        (single-writer architecture). Crash before the delete persists is
+        safe: rows resend, the receiver dedupes and re-ACKs."""
         if self._db is not None:
-            import sqlite3
-
-            try:
-                with self._db.aux_lock:
-                    self._db.aux_conn.executemany(
-                        "DELETE FROM outbox WHERE unique_id = ?",
-                        [(u,) for u in unique_ids])
-                    self._db.aux_conn.commit()
-            except sqlite3.OperationalError:
-                # Write lock held past busy_timeout (an unusually long node
-                # round): leave the rows; the replay loop redelivers and the
-                # receiver's dedupe + re-ACK retire them next pass.
-                pass
+            with self._lock:
+                self._retired.extend(unique_ids)
         else:
             drop = set(unique_ids)
             with self._lock:
                 self._mem = [e for e in self._mem if e[2] not in drop]
+
+    def flush_retired(self) -> None:
+        """Delete ACK-retired rows on the NODE thread's connection (called
+        from pump/flush_round; rides the round batch when one is open).
+
+        Takes db.lock: outside a round batch the shared connection may be
+        mid-transaction on a foreign thread (webserver upload), and a bare
+        commit here would make its half-built writes durable. Errors are
+        absorbed — the rows stay, the frames resend, the receiver dedupes
+        and re-ACKs (the same at-least-once recovery every other outbox
+        failure path leans on)."""
+        if self._db is None:
+            return
+        with self._lock:
+            retired, self._retired = self._retired, []
+        if not retired:
+            return
+        import sqlite3
+
+        try:
+            with self._db.lock:
+                self._db.conn.executemany(
+                    "DELETE FROM outbox WHERE unique_id = ?",
+                    [(u,) for u in retired])
+                self._db.commit()
+        except (sqlite3.OperationalError, sqlite3.ProgrammingError):
+            pass  # busy or closing: redelivery + dedupe retire them later
 
 
 class _Dedupe:
@@ -590,6 +621,7 @@ class TcpMessaging(MessagingService):
         first message. max_messages bounds one pump call so a round (and its
         db transaction, which holds the sqlite write lock) stays short under
         firehose load — leftover messages are dispatched next round."""
+        self._outbox.flush_retired()  # node thread: the ONE sqlite writer
         n = attempts = 0
         while True:
             if max_messages is not None and attempts >= max_messages:
@@ -673,6 +705,7 @@ class TcpMessaging(MessagingService):
         up to max_messages frames: at firehose load the per-ACK serialize +
         sendall was the single hottest item in the round profile."""
         self._dedupe.round_committed()
+        self._outbox.flush_retired()
         acks, self._deferred_acks = self._deferred_acks, []
         by_conn: dict[int, tuple[Any, list[bytes]]] = {}
         for conn, unique_id in acks:

@@ -211,6 +211,7 @@ class RaftMember:
         self.snapshot_index = int(db.get_setting("raft_snapshot_index") or 0)
         self.snapshot_term = int(db.get_setting("raft_snapshot_term") or 0)
         self._votes: set[str] = set()
+        self._election_attempts = 0  # consecutive failed elections (backoff)
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._last_heartbeat = self.clock()
@@ -301,7 +302,13 @@ class RaftMember:
 
     def _next_election_deadline(self) -> float:
         lo, hi = self.ELECTION_TIMEOUT
-        return self.clock() + self.rng.uniform(lo, hi) * self.scale
+        # Randomized-timeout backoff: under a coarse scheduler (nodes pumped
+        # round-robin, each round gated on fsync) the base window quantizes
+        # to pump-cycle granularity and two candidates can split votes
+        # REPEATEDLY. Each consecutive failed election widens the window, so
+        # collisions decay geometrically instead of recurring for seconds.
+        spread = 1.0 + 0.5 * min(self._election_attempts, 6)
+        return self.clock() + self.rng.uniform(lo, hi * spread) * self.scale
 
     def tick(self) -> None:
         now = self.clock()
@@ -332,9 +339,12 @@ class RaftMember:
         self.role = "follower"
         if leader is not None:
             self.leader_name = leader
+            self._election_attempts = 0  # a live leader resets the backoff
         self._election_deadline = self._next_election_deadline()
 
     def _start_election(self) -> None:
+        if self.role == "candidate":
+            self._election_attempts += 1  # previous election went nowhere
         self.term += 1
         self.voted_for = self.name
         self._save_meta()
@@ -354,6 +364,7 @@ class RaftMember:
         if len(self._votes) * 2 > len(self.peers) + 1:
             self.role = "leader"
             self.leader_name = self.name
+            self._election_attempts = 0
             last_idx, _ = self._log_last()
             self._next_index = {p: last_idx + 1 for p in self.peers}
             self._match_index = {p: 0 for p in self.peers}
@@ -432,6 +443,26 @@ class RaftMember:
                 self.voted_for = rv.candidate
                 self._save_meta()
                 self._election_deadline = self._next_election_deadline()
+        if (not granted and self.role == "candidate"
+                and rv.term == self.term):
+            # Symmetric-candidacy livelock breaker (observed under a coarse
+            # round-robin scheduler whose pump cycle exceeded the election
+            # timeout: both members' timers expired EVERY cycle, each voted
+            # for itself each term, forever). Safety-neutral tiebreak — the
+            # vote stays rejected (no double voting); the LOWER-priority
+            # candidate merely stops racing: it steps down and sits out a
+            # full election window, so the rival runs the next term alone.
+            last_idx, last_term = self._log_last()
+            rival_priority = ((rv.last_log_term, rv.last_log_index,
+                               rv.candidate)
+                              >= (last_term, last_idx, self.name))
+            if rival_priority:
+                self.role = "follower"
+                # Long enough for the rival's next election AND its
+                # RequestVote to traverse a slow pump cycle before our
+                # timer can fire again.
+                lo, hi = self.ELECTION_TIMEOUT
+                self._election_deadline = self.clock() + 4 * hi * self.scale
         self._send(sender, VoteReply(self.term, granted, self.name))
 
     def _on_vote_reply(self, vr: VoteReply) -> None:
@@ -732,7 +763,13 @@ class RaftUniquenessProvider(UniquenessProvider):
     RESUBMIT_EVERY = 0.5  # sec; re-offer after leader changes (idempotent)
 
     def __init__(self, member: RaftMember, pump: Callable[[], None],
-                 timeout: float = 10.0):
+                 timeout: float = 25.0):
+        # 25 s, not 10: the commit poll RESUBMITS through leader changes
+        # (idempotent request ids), so the window only bounds how long a
+        # caller waits out cluster unavailability. Measured leaderless
+        # blips under a coarse scheduler (an election churn episode plus
+        # redelivery backoff) recover in 10-20 s — a 10 s window turned
+        # exactly those transients into spurious tx rejections.
         self.member = member
         self._pump = pump  # drives messaging + raft ticks while waiting
         self.timeout = timeout

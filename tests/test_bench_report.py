@@ -11,9 +11,8 @@ import bench
 
 
 def _stub_phases(monkeypatch):
-    # Never run the real subprocess device probe in tests: on a host with a
-    # wedged accelerator tunnel it burns its full timeout per call.
-    monkeypatch.setattr(bench, "_device_reachable", lambda *a, **k: True)
+    # Never run real device init in tests: on a host with a wedged
+    # accelerator tunnel it burns its full timeout per call.
     monkeypatch.setattr(bench, "_device_init_with_timeout",
                         lambda *a, **k: "stub-device")
     monkeypatch.setattr(bench, "_warm_verify_kernel", lambda: None)
@@ -78,7 +77,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
     # measure every host-side config instead of producing nothing.
     _stub_phases(monkeypatch)
     monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
-    monkeypatch.setattr(bench, "_device_reachable", lambda *a, **k: False)
+    monkeypatch.setattr(bench, "_device_init_with_timeout",
+                    lambda *a, **k: None)
     monkeypatch.setattr(bench, "make_corpus",
                         lambda *a: ([b"pk"], [b"m"], [b"s"], [True]))
     bench.main()

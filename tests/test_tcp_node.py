@@ -34,12 +34,16 @@ def make_node(tmp_path, name, notary="none", netmap="netmap.json", **kw):
 
 
 def pump_until(nodes, predicate, timeout=15.0):
-    """Round-robin run_once across nodes until predicate() or timeout."""
+    """Round-robin run_once across nodes until predicate() or timeout.
+
+    Netmap refresh is throttled (as in production run_forever): re-reading
+    the file every iteration made each pump cycle slow enough to quantize
+    raft election timeouts to cycle boundaries — repeated split votes."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         for node in nodes:
             node.run_once(timeout=0.01)
-            node.refresh_netmap()
+            node.refresh_netmap_maybe(every=0.2)
         if predicate():
             return
     raise AssertionError("timed out waiting for network to settle")
