@@ -618,10 +618,14 @@ def _install_watchdog(seconds: int, report: dict):
     except (ValueError, OSError):
         pass  # non-main thread / platform without SIGALRM
 
+    generation = _run_generation
+
     def backstop():
         time.sleep(seconds + 60)
-        if _printed:
-            return  # run completed; never kill a host process post-hoc
+        if _printed or generation != _run_generation:
+            # Run completed (or a NEWER run owns the process): a stale
+            # backstop must never kill a healthy host process post-hoc.
+            return
         hard = (f"bench hard-watchdog: unresponsive after {seconds + 60}s "
                 f"(uninterruptible hang)")
         # Snapshot under the print lock; a concurrently-mutating report can
@@ -650,6 +654,7 @@ import threading as _threading
 
 _print_lock = _threading.Lock()
 _printed = False
+_run_generation = 0  # incremented per main(); stale backstops check it
 
 
 def _print_report_once(report: dict) -> None:
@@ -694,8 +699,9 @@ def _device_init_with_timeout(timeout_s: float = 300.0) -> str | None:
 def main():
     import os
 
-    global _printed
+    global _printed, _run_generation
     _printed = False  # one line per RUN (tests invoke main() repeatedly)
+    _run_generation += 1
     # The report is built PROGRESSIVELY so the watchdog can still print one
     # honest JSON line carrying everything that finished before a wedge.
     report = {
@@ -771,10 +777,11 @@ def _run_phases(report: dict) -> None:
     except Exception:
         pass  # older jax: cache knobs absent; just compile
 
-    # The subprocess probe can pass and the tunnel wedge seconds later
-    # (observed: a flapping relay), so device init runs in a worker thread
-    # with a join timeout; on timeout the host-side configs still get
-    # measured (the stuck thread is deliberately leaked).
+    # Device init runs in a worker thread with a join timeout — the ONE
+    # liveness gate: the observed tunnel wedge blocks uninterruptibly (and
+    # can flap, so a prior successful probe proves nothing). On timeout the
+    # stuck thread is deliberately leaked and the host-side configs still
+    # get measured.
     report["phase"] = "device_init"
     device = _device_init_with_timeout(300.0)
     if device is None:

@@ -87,6 +87,20 @@ class NotaryTransactionInvalid(NotaryError):
 
 @register
 @dataclass(frozen=True)
+class NotaryUnavailable(NotaryError):
+    """The notary could not decide in time (e.g. a Raft leadership episode
+    outlasted the commit window). RETRYABLE: unlike the other errors this
+    says nothing about the transaction — resubmitting the same tx later is
+    safe and expected (commit is idempotent, first-committer-wins)."""
+
+    reason: str = ""
+
+    def __str__(self):
+        return f"Notary service temporarily unavailable: {self.reason}"
+
+
+@register
+@dataclass(frozen=True)
 class NotarySignaturesMissing(NotaryError):
     missing: frozenset
 
@@ -248,7 +262,10 @@ class NotaryServiceFlow(FlowLogic):
         node keeps pumping consensus traffic (blocking in-place would starve
         the very message loop the quorum round needs). Generator either way
         (yield-from'd by call())."""
-        from ..node.services.api import UniquenessException
+        from ..node.services.api import (
+            UniquenessException,
+            UniquenessUnavailableException,
+        )
         from ..serialization.codec import serialize
 
         provider = self.service.uniqueness_provider
@@ -263,6 +280,12 @@ class NotaryServiceFlow(FlowLogic):
             conflict_data = serialize(e.error)
             signed = SignedData(conflict_data, self.service.sign(conflict_data.bytes))
             raise NotaryException(NotaryConflict(wtx.id, signed)) from e
+        except UniquenessUnavailableException as e:
+            # A consensus window elapsing says NOTHING about the tx: reply
+            # with the RETRYABLE unavailability error, never "transaction
+            # invalid" (which would mislead a client into abandoning a
+            # perfectly good transaction).
+            raise NotaryException(NotaryUnavailable(str(e))) from e
 
 
 @register_flow

@@ -111,12 +111,15 @@ class _Outbox:
             with self._lock:
                 retired = set(self._retired)
             with self._db.aux_lock:
+                # Over-fetch by the retired count: the filter below runs
+                # AFTER the SQL limit, and a window of retired-but-undeleted
+                # rows must not mask a live frame sitting just beyond it.
                 rows = self._db.aux_conn.execute(
                     "SELECT seq, unique_id, blob FROM outbox WHERE peer = ? "
                     "AND seq > ? ORDER BY seq LIMIT ?",
-                    (peer, after_seq, limit)).fetchall()
+                    (peer, after_seq, limit + len(retired))).fetchall()
             return [(s, bytes(u), bytes(b)) for s, u, b in rows
-                    if bytes(u) not in retired]
+                    if bytes(u) not in retired][:limit]
         with self._lock:
             return [(s, u, f) for s, p, u, f in self._mem
                     if p == peer and s > after_seq][:limit]

@@ -235,6 +235,14 @@ class UniquenessConflict:
     state_history: dict  # StateRef -> ConsumingTx
 
 
+class UniquenessUnavailableException(Exception):
+    """The uniqueness provider could not DECIDE in time (consensus quorum /
+    leadership unavailable). Retriable, and says nothing about the
+    transaction — the typed sibling of UniquenessException so callers never
+    confuse "degraded service" with "double spend". Concrete providers
+    subclass (raft.CommitTimeoutException)."""
+
+
 @register_flow_exception
 class UniquenessException(Exception):
     """Keeps its structured conflict through checkpoint replay."""

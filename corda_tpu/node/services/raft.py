@@ -42,6 +42,7 @@ from .api import (
     UniquenessConflict,
     UniquenessException,
     UniquenessProvider,
+    UniquenessUnavailableException,
 )
 
 RAFT_TOPIC = "platform.raft"
@@ -741,7 +742,7 @@ from ...utils.excheckpoint import register_flow_exception
 
 
 @register_flow_exception
-class CommitTimeoutException(Exception):
+class CommitTimeoutException(UniquenessUnavailableException):
     """The cluster could not commit within the deadline (no quorum/leader).
     Distinct from UniquenessException: a timeout is retriable, a conflict is
     final — surfacing one as the other would tell a client its transaction

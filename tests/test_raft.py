@@ -287,3 +287,50 @@ def test_chunked_snapshot_and_dead_peer_compaction(tmp_path, monkeypatch):
     finally:
         for n in everyone:
             n.stop()
+
+
+def test_commit_timeout_reports_retryable_unavailable():
+    # A consensus window elapsing says nothing about the transaction: the
+    # client must receive the RETRYABLE NotaryUnavailable error, never
+    # NotaryTransactionInvalid (which would tell it to abandon a good tx).
+    from corda_tpu.flows.notary import (
+        NotaryClientFlow,
+        NotaryException,
+        NotaryUnavailable,
+    )
+    from corda_tpu.node.services.raft import CommitTimeoutException
+    from corda_tpu.testing.mock_network import MockNetwork
+    from corda_tpu.testing.dummies import DummyContract
+
+    import pytest
+
+    net = MockNetwork()
+    try:
+        notary = net.create_notary_node("Notary", validating=False)
+        alice = net.create_node("Alice")
+
+        class TimingOutProvider:
+            def commit(self, states, tx_id, caller):
+                raise CommitTimeoutException(
+                    "raft commit not decided within 25.0s (leader: None)")
+
+        notary.notary_service.uniqueness_provider = TimingOutProvider()
+
+        builder = DummyContract.generate_initial(
+            alice.identity.ref(b"\x01"), 1, notary.identity)
+        builder.sign_with(alice.key)
+        issue = builder.to_signed_transaction()
+        alice.record_transaction(issue)
+        move = DummyContract.move(issue.tx.out_ref(0),
+                                  alice.identity.owning_key)
+        move.sign_with(alice.key)
+        stx = move.to_signed_transaction(check_sufficient_signatures=False)
+
+        h = alice.start_flow(NotaryClientFlow(stx))
+        net.run_network()
+        with pytest.raises(NotaryException) as exc:
+            h.result.result()
+        assert isinstance(exc.value.error, NotaryUnavailable)
+        assert "not decided" in exc.value.error.reason
+    finally:
+        net.stop_nodes()
