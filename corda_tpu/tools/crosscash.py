@@ -323,6 +323,8 @@ def run_crosscash(
                 time.sleep(0.4)
             if not converged:
                 break  # report the divergence; do not compound it
+        for rpc in rpcs.values():
+            rpc.close()
     return CrossCashResult(
         waves=n_waves, commands_run=n_run, commands_committed=n_ok,
         commands_rejected=n_rej, converged=converged,

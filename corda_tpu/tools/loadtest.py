@@ -324,6 +324,8 @@ def run_loadtest_multiprocess(
                 f"loadtest did not finish in {max_seconds}s: {results}")
         wall = time.perf_counter() - t_start
         after = [r.call("node_metrics") for r in rpcs]
+        for r in rpcs:
+            r.close()
 
     sigs = sum(a["verify_sigs"] - b["verify_sigs"]
                for a, b in zip(after, before))
@@ -409,6 +411,7 @@ def run_latency_sweep(
                 raise TimeoutError(
                     f"open-loop sweep at {rate} tx/s did not finish "
                     f"in {max_seconds}s")
+        rpc.close()
     return results
 
 
