@@ -16,7 +16,7 @@ from ..serialization.codec import register
 from ..transactions.signed import SignedTransaction
 from ..utils.progress import ProgressTracker, Step
 from .api import FlowLogic, register_flow
-from .notary import NotaryClientFlow
+from .notary import notarise_with_retry
 
 
 @register
@@ -62,10 +62,10 @@ class FinalityFlow(FlowLogic):
         stx = self.transaction
         if self._needs_notary_signature(stx):
             self.progress_tracker.current_step = self.NOTARISING
-            notary_flow = NotaryClientFlow(stx)
-            self.progress_tracker.set_child_tracker(
-                self.NOTARISING, notary_flow.progress_tracker)
-            notary_sig = yield from self.sub_flow(notary_flow)
+            notary_sig = yield from notarise_with_retry(
+                self, stx,
+                on_attempt=lambda nf: self.progress_tracker.set_child_tracker(
+                    self.NOTARISING, nf.progress_tracker))
             stx = stx.with_additional_signature(notary_sig)
         self.progress_tracker.current_step = self.BROADCASTING
         yield from self.sub_flow(

@@ -191,6 +191,32 @@ class NotaryClientFlow(FlowLogic):
         )
 
 
+def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
+                        retries: int = 2, on_attempt=None):
+    """yield-from helper: notarise `stx` via a fresh NotaryClientFlow per
+    attempt, retrying ONLY the RETRYABLE NotaryUnavailable error (a
+    consensus window elapsing says nothing about the tx, and commit is
+    idempotent first-committer-wins). A fresh sub-flow per attempt matters:
+    each one opens its own session, because the service flow ends after
+    replying. `on_attempt(notary_flow)` lets callers hook up progress
+    trackers. The PRODUCT call sites (FinalityFlow, NotaryChangeFlow) share
+    this policy; the load/bench tools (loadgen, loadtest, demo_cordapp)
+    deliberately call NotaryClientFlow raw — retries there would mask the
+    availability behaviour they exist to measure."""
+    attempt = 0
+    while True:
+        notary_flow = NotaryClientFlow(stx)
+        if on_attempt is not None:
+            on_attempt(notary_flow)
+        try:
+            return (yield from flow.sub_flow(notary_flow))
+        except NotaryException as e:
+            if isinstance(e.error, NotaryUnavailable) and attempt < retries:
+                attempt += 1
+                continue
+            raise
+
+
 # ---------------------------------------------------------------------------
 # Service (reference: NotaryFlow.kt:96-147)
 # ---------------------------------------------------------------------------

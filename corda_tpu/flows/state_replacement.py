@@ -24,7 +24,7 @@ from ..transactions.builder import NotaryChangeBuilder
 from ..transactions.signed import SignedTransaction
 from .api import FlowException, FlowLogic, register_flow
 from .finality import FinalityFlow
-from .notary import NotaryClientFlow
+from .notary import notarise_with_retry
 
 
 class StateReplacementException(FlowException):
@@ -85,7 +85,7 @@ class NotaryChangeFlow(FlowLogic):
 
         # Notarise with the OLD notary (it controls the consumed state) and
         # broadcast to everyone involved.
-        notary_sig = yield from self.sub_flow(NotaryClientFlow(stx))
+        notary_sig = yield from notarise_with_retry(self, stx)
         final = stx.with_additional_signature(notary_sig)
         yield from self.sub_flow(FinalityFlow(
             final, tuple(parties) + (self.service_hub.my_identity,)))

@@ -153,6 +153,7 @@ class Driver:
     def __init__(self, base_dir: Path):
         self.base_dir = Path(base_dir)
         self.nodes: list[NodeProcess] = []
+        self._deferred: list = []  # cleanup callbacks (run first in stop_all)
         self.netmap = self.base_dir / "netmap.json"
 
     def start_node(self, name: str, notary: str = "none",
@@ -223,7 +224,18 @@ class Driver:
             reborn.wait_up()
         return reborn
 
+    def defer(self, cleanup) -> None:
+        """Register a cleanup (e.g. an RpcClient.close) to run at driver
+        exit, BEFORE nodes are stopped — success or exception alike."""
+        self._deferred.append(cleanup)
+
     def stop_all(self) -> None:
+        for cleanup in self._deferred:
+            try:
+                cleanup()
+            except Exception:
+                pass
+        self._deferred.clear()
         for node in self.nodes:
             if node.process.poll() is None:
                 try:

@@ -261,6 +261,7 @@ def run_crosscash(
             handle = d.start_node(
                 name, rpc=True, cordapps=("corda_tpu.tools.crosscash",))
             rpcs[name] = handle.rpc("demo", "s3cret", timeout=60.0)
+            d.defer(rpcs[name].close)
 
         kinds = ((disrupt,) if isinstance(disrupt, str)
                  else tuple(disrupt or ()))
@@ -323,8 +324,6 @@ def run_crosscash(
                 time.sleep(0.4)
             if not converged:
                 break  # report the divergence; do not compound it
-        for rpc in rpcs.values():
-            rpc.close()
     return CrossCashResult(
         waves=n_waves, commands_run=n_run, commands_committed=n_ok,
         commands_rejected=n_rej, converged=converged,

@@ -270,6 +270,7 @@ def run_loadtest_multiprocess(
                 extra_toml=client_extra))
         for h in handles:
             rpcs.append(h.rpc("demo", "s3cret", timeout=60.0))
+            d.defer(rpcs[-1].close)
         member_rpcs = []  # metrics need an RPC user on notary nodes too? No:
         # notary metrics ride the clients' results + their own counters are
         # only needed for validating mode; gather via a metrics RPC only on
@@ -324,8 +325,6 @@ def run_loadtest_multiprocess(
                 f"loadtest did not finish in {max_seconds}s: {results}")
         wall = time.perf_counter() - t_start
         after = [r.call("node_metrics") for r in rpcs]
-        for r in rpcs:
-            r.close()
 
     sigs = sum(a["verify_sigs"] - b["verify_sigs"]
                for a, b in zip(after, before))
@@ -383,6 +382,7 @@ def run_latency_sweep(
                               cordapps=("corda_tpu.tools.loadgen",),
                               extra_toml=toml_extra)
         rpc = client.rpc("demo", "s3cret", timeout=60.0)
+        d.defer(rpc.close)
         # Warm-up: a tiny closed-loop burst drives session establishment,
         # netmap propagation and first-contact code paths OUTSIDE the
         # measured rates — a cold-start redelivery backoff would otherwise
@@ -411,7 +411,6 @@ def run_latency_sweep(
                 raise TimeoutError(
                     f"open-loop sweep at {rate} tx/s did not finish "
                     f"in {max_seconds}s")
-        rpc.close()
     return results
 
 

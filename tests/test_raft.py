@@ -334,3 +334,49 @@ def test_commit_timeout_reports_retryable_unavailable():
         assert "not decided" in exc.value.error.reason
     finally:
         net.stop_nodes()
+
+
+def test_finality_retries_through_transient_unavailability():
+    # NotaryUnavailable is RETRYABLE and FinalityFlow acts on it: a notary
+    # whose commit window lapses twice (degraded cluster) then recovers
+    # still finalises the transaction without caller involvement.
+    from corda_tpu.flows.finality import FinalityFlow
+    from corda_tpu.node.services.raft import CommitTimeoutException
+    from corda_tpu.testing.mock_network import MockNetwork
+    from corda_tpu.testing.dummies import DummyContract
+
+    net = MockNetwork()
+    try:
+        notary = net.create_notary_node("Notary", validating=False)
+        alice = net.create_node("Alice")
+
+        real_provider = notary.notary_service.uniqueness_provider
+        calls = {"n": 0}
+
+        class FlakyProvider:
+            def commit(self, states, tx_id, caller):
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise CommitTimeoutException("no quorum")
+                return real_provider.commit(states, tx_id, caller)
+
+        notary.notary_service.uniqueness_provider = FlakyProvider()
+
+        builder = DummyContract.generate_initial(
+            alice.identity.ref(b"\x01"), 1, notary.identity)
+        builder.sign_with(alice.key)
+        issue = builder.to_signed_transaction()
+        alice.record_transaction(issue)
+        move = DummyContract.move(issue.tx.out_ref(0),
+                                  alice.identity.owning_key)
+        move.sign_with(alice.key)
+        stx = move.to_signed_transaction(check_sufficient_signatures=False)
+
+        h = alice.start_flow(FinalityFlow(stx, (alice.identity,)))
+        net.run_network()
+        final = h.result.result()  # two failures + one success = finalised
+        assert calls["n"] == 3
+        assert any(s.by in notary.identity.owning_key.keys
+                   for s in final.sigs)
+    finally:
+        net.stop_nodes()
