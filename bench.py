@@ -612,11 +612,32 @@ def _install_watchdog(seconds: int, report: dict):
     def on_alarm(signum, frame):
         raise BenchTimeout(f"bench watchdog fired after {seconds}s")
 
+    prev_handler = None
+    armed = False
     try:
-        signal.signal(signal.SIGALRM, on_alarm)
+        prev_handler = signal.signal(signal.SIGALRM, on_alarm)
         signal.alarm(seconds)
+        armed = True
     except (ValueError, OSError):
         pass  # non-main thread / platform without SIGALRM
+
+    def cancel():
+        """Disarm the soft watchdog once the run finished: an embedding
+        process that lives past the deadline must not take a stale
+        BenchTimeout in unrelated code. (The backstop thread self-gates on
+        _printed / generation.)"""
+        if not armed:
+            return
+        try:
+            signal.alarm(0)
+            # prev_handler is None when the prior handler was installed
+            # from C — on_alarm must still come OFF; SIG_DFL is the least
+            # surprising stand-in we can restore.
+            signal.signal(signal.SIGALRM,
+                          prev_handler if prev_handler is not None
+                          else signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
 
     generation = _run_generation
 
@@ -648,6 +669,7 @@ def _install_watchdog(seconds: int, report: dict):
 
     threading.Thread(target=backstop, daemon=True,
                      name="bench-hard-watchdog").start()
+    return cancel
 
 
 import threading as _threading
@@ -710,18 +732,22 @@ def main():
         "unit": "sigs/sec",
         "vs_baseline": 0.0,
     }
-    _install_watchdog(
-        int(os.environ.get("CORDA_TPU_BENCH_TIMEOUT", "2700")), report)
+    cancel_watchdog = _install_watchdog(
+        int(os.environ.get("CORDA_TPU_BENCH_TIMEOUT", "2700")), report) \
+        or (lambda: None)  # tests stub the installer out
     try:
-        _run_phases(report)
-    except BenchTimeout as e:
-        # Append rather than overwrite: degraded mode may already carry the
-        # root-cause attribution (accelerator unreachable).
-        prior = report.get("error")
-        report["error"] = f"{prior}; {e}" if prior else str(e)
-        report["error_phase"] = report.get("phase")
-    report.pop("phase", None)
-    _print_report_once(report)
+        try:
+            _run_phases(report)
+        except BenchTimeout as e:
+            # Append rather than overwrite: degraded mode may already carry
+            # the root-cause attribution (accelerator unreachable).
+            prior = report.get("error")
+            report["error"] = f"{prior}; {e}" if prior else str(e)
+            report["error_phase"] = report.get("phase")
+        report.pop("phase", None)
+        _print_report_once(report)
+    finally:
+        cancel_watchdog()
 
 
 def _run_host_only_phases(report: dict) -> None:

@@ -155,6 +155,19 @@ def _module_allowed(name: str, whitelist: tuple[str, ...]) -> bool:
     return any(name == w or name.startswith(w + ".") for w in whitelist)
 
 
+# The interpreter-level callable types that genuinely carry no __code__ /
+# __globals__. Only these may earn trust through module OWNERSHIP in
+# _trusted_home — any Python-defined object can forge the same attribute
+# surface, but it cannot forge its C-level type.
+_C_CALLABLE_TYPES = (
+    types.BuiltinFunctionType,  # == BuiltinMethodType
+    types.WrapperDescriptorType,
+    types.MethodWrapperType,
+    types.MethodDescriptorType,
+    types.ClassMethodDescriptorType,
+)
+
+
 def _is_dataclass_hash(cls: type, attr) -> bool:
     """True only for the __hash__ dataclasses generates for frozen/eq
     classes: defined on a dataclass, compiled from the '<string>' source
@@ -164,12 +177,14 @@ def _is_dataclass_hash(cls: type, attr) -> bool:
     (Forging this shape needs compile()/exec(), which module vetting bans.)
     """
     code = getattr(attr, "__code__", None)
+    # co_consts may carry the empty tuple: a fieldless frozen dataclass
+    # hashes `()`, so its generated __hash__ embeds it as a constant.
     return (isinstance(attr, types.FunctionType)
             and code is not None
             and "__dataclass_fields__" in vars(cls)
             and code.co_filename == "<string>"
             and not code.co_freevars
-            and set(code.co_consts) <= {None}
+            and set(code.co_consts) <= {None, ()}
             and set(code.co_names)
             <= {"hash"} | set(cls.__dataclass_fields__))
 
@@ -184,6 +199,7 @@ class DeterministicSandbox:
         self.budget = budget
         self.module_whitelist = tuple(module_whitelist)
         self._vetted: set[types.CodeType] = set()
+        self._vetting_instances: set[int] = set()
 
     # ------------------------------------------------------------- vetting
 
@@ -230,12 +246,34 @@ class DeterministicSandbox:
         setattr/STORE_ATTR (both vetted away). The __module__ leg accepts
         e.g. platform functions; the __globals__ leg accepts whitelisted-
         module wrappers whose __module__ was overwritten by wraps (e.g.
-        dataclasses' _recursive_repr around a generated __repr__)."""
-        import sys
+        dataclasses' _recursive_repr around a generated __repr__).
 
+        C-level callables (math.floor, a descriptor's builtin accessor)
+        carry no __globals__ at all, so the identity check above can never
+        pass; for those, trust requires the claimed whitelisted module to
+        actually OWN the object — it is bound to the module (__self__) or
+        reachable under its own name there. A bare __module__ string still
+        earns nothing."""
         globs = getattr(fn, "__globals__", None)
+        if globs is None and getattr(fn, "__code__", None) is None:
+            # Only REAL C-callable types qualify for the ownership leg: a
+            # user instance can forge __module__/__self__ as class
+            # attributes (type() with an arbitrary dict) but cannot forge
+            # its own Python type.
+            if not isinstance(fn, _C_CALLABLE_TYPES):
+                return False
+            mod_name = getattr(fn, "__module__", None)
+            if not isinstance(mod_name, str) or not _module_allowed(
+                    mod_name, self.module_whitelist):
+                return False
+            owner = sys.modules.get(mod_name)
+            if owner is None:
+                return False
+            if getattr(fn, "__self__", None) is owner:
+                return True
+            return getattr(owner, getattr(fn, "__name__", ""), None) is fn
         names = (getattr(fn, "__module__", None),
-                 (globs or {}).get("__name__"))
+                 globs.get("__name__") if globs else None)
         for name in names:
             if not isinstance(name, str) or not _module_allowed(
                     name, self.module_whitelist):
@@ -244,6 +282,28 @@ class DeterministicSandbox:
             if mod is not None and getattr(mod, "__dict__", None) is globs:
                 return True
         return False
+
+    def _trusted_class(self, cls: type) -> bool:
+        """Is `cls` genuinely defined in a whitelisted module (or builtins)?
+        Like _trusted_home, a bare __module__ string is forgeable
+        (functools.wraps works on classes too), so the claimed module must
+        actually own the class: walking the qualname from the module object
+        must arrive back at this exact class object."""
+        mod_name = getattr(cls, "__module__", None)
+        if not isinstance(mod_name, str):
+            return False
+        if mod_name == "builtins":
+            owner = builtins
+        elif _module_allowed(mod_name, self.module_whitelist):
+            owner = sys.modules.get(mod_name)
+        else:
+            return False
+        obj = owner
+        for part in getattr(cls, "__qualname__", cls.__name__).split("."):
+            if part == "<locals>" or obj is None:
+                return False
+            obj = getattr(obj, part, None)
+        return obj is cls
 
     def _vet_code(self, code: types.CodeType, globs: dict,
                   closure: dict | None = None) -> None:
@@ -363,26 +423,94 @@ class DeterministicSandbox:
                     f"{where}: reference to non-whitelisted module "
                     f"{value.__name__!r} (as {name!r})")
             return
-        mod = getattr(value, "__module__", None)
-        if mod is not None and _module_allowed(mod, self.module_whitelist):
-            return  # platform/whitelisted code is trusted as-is
-        if mod == "builtins":
-            vetted_name = getattr(value, "__name__", name)
-            self._vet_name(vetted_name, {}, where)
-            return
-        # User code from a non-whitelisted module: recurse into it.
+        # Functions and classes FIRST: their __module__ is a bare string
+        # functools.wraps can stamp with a whitelisted name, so it earns no
+        # trust here. vet() / _trusted_class decide by sys.modules identity
+        # and everything that fails that check is vetted as user code.
         if isinstance(value, (types.FunctionType, types.MethodType)):
             self.vet(value)
             return
         if isinstance(value, type):
+            if self._trusted_class(value):
+                # Ownership established — but an ALIAS of a forbidden
+                # builtin type (memoryview) must still fail the name
+                # screen, exactly as the spelled-out name would.
+                if value.__module__ == "builtins":
+                    self._vet_name(value.__name__, {}, where)
+                return
             self._vet_class(value, where)
             return
-        if isinstance(value, (int, float, str, bytes, bool, tuple, frozenset,
-                              complex)) or value is None:
-            return  # immutable constants
+        if callable(value) and getattr(value, "__code__", None) is None \
+                and self._trusted_home(value):
+            return  # C-level callable genuinely owned by a whitelisted module
+        mod = getattr(value, "__module__", None)
+        if mod == "builtins" and isinstance(value, _C_CALLABLE_TYPES):
+            # Identity discipline, not a string compare: a user instance
+            # forging __module__="builtins" has the wrong Python type and
+            # never lands here; a genuine C callable must additionally BE
+            # the object the builtins namespace owns under its qualname
+            # (len, dict.get, ...) before the name screen decides.
+            obj = builtins
+            for part in getattr(value, "__qualname__",
+                                getattr(value, "__name__", "")).split("."):
+                obj = getattr(obj, part, None)
+            if obj is value:
+                self._vet_name(getattr(value, "__name__", name), {}, where)
+                return
+            raise SandboxViolation(
+                f"{where}: C callable {name!r} claims builtins but is not "
+                f"owned by it")
+        if isinstance(value, (int, float, str, bytes, bool, complex)) \
+                or value is None:
+            return  # immutable scalar constants
+        if isinstance(value, (tuple, frozenset)):
+            # Immutable CONTAINERS are only as safe as their contents: a
+            # tuple is the one-line smuggle for a real builtin ((open,)[0]
+            # from confined code), so every element is vetted.
+            for i, item in enumerate(value):
+                self._vet_value(f"{name}[{i}]", item, where)
+            return
+        # Instances pass only when their CLASS genuinely lives in a
+        # whitelisted module (identity, not the forgeable string) AND the
+        # instance is an immutable value shape whose PAYLOAD also vets:
+        # mutable containers are cross-replay state, and callable wrappers
+        # (functools.partial over open) or a frozen dataclass field holding
+        # open smuggle real builtins past confinement.
+        self._vet_instance(name, value, where)
+
+    def _vet_instance(self, name: str, value, where: str) -> None:
+        """Vet an instance global: trusted-class enum members, frozen
+        dataclasses (fields vetted recursively — a field can hold any
+        object), and the well-known numeric value types. Deliberately
+        closed-world: everything else is rejected."""
+        import dataclasses
+        import decimal
+        import enum
+        import fractions
+
+        cls = type(value)
+        if self._trusted_class(cls):
+            if id(value) in self._vetting_instances:
+                return  # cycle (only constructible by platform C tricks)
+            self._vetting_instances.add(id(value))
+            try:
+                if isinstance(value, enum.Enum):
+                    self._vet_value(f"{name}.value", value.value, where)
+                    return
+                params = getattr(cls, "__dataclass_params__", None)
+                if params is not None and getattr(params, "frozen", False):
+                    for f in dataclasses.fields(cls):
+                        self._vet_value(f"{name}.{f.name}",
+                                        getattr(value, f.name, None), where)
+                    return
+                if isinstance(value, (decimal.Decimal, fractions.Fraction)):
+                    return
+            finally:
+                self._vetting_instances.discard(id(value))
         raise SandboxViolation(
             f"{where}: global {name!r} of type {type(value).__name__} from "
-            f"non-whitelisted module {mod!r}")
+            f"non-whitelisted module "
+            f"{getattr(value, '__module__', None)!r}")
 
     def _vet_class(self, cls: type, where: str,
                    seen: set[type] | None = None) -> None:
@@ -396,9 +524,7 @@ class DeterministicSandbox:
             return
         seen.add(cls)
         for base in cls.__bases__:
-            mod = getattr(base, "__module__", "") or ""
-            if mod == "builtins" or _module_allowed(
-                    mod, self.module_whitelist):
+            if self._trusted_class(base):
                 continue
             self._vet_class(base, where, seen)
         for name, attr in vars(cls).items():
@@ -434,8 +560,12 @@ class DeterministicSandbox:
                 self._vet_class(attr, where, seen)
                 continue
             if attr is None or isinstance(
-                    attr, (int, float, str, bytes, bool, tuple, frozenset,
-                           complex)):
+                    attr, (int, float, str, bytes, bool, complex)):
+                continue
+            if isinstance(attr, (tuple, frozenset)):
+                # Same contents rule as module globals: `T = (open,)` as a
+                # class attribute is the identical smuggle one level down.
+                self._vet_value(f"{cls.__name__}.{name}", attr, where)
                 continue
             # Arbitrary descriptors (functools.cached_property, user
             # __get__ objects, …) carry code the simple walk above misses:
@@ -465,8 +595,9 @@ class DeterministicSandbox:
         attachments loader uses. Platform (whitelisted-module) functions run
         unmodified."""
         fn = getattr(fn, "__func__", fn)
-        if _module_allowed(getattr(fn, "__module__", "") or "",
-                           self.module_whitelist):
+        # Same identity rule as vetting: a wraps-stamped __module__ string
+        # must not exempt user code from confinement.
+        if self._trusted_home(fn):
             return fn
         code = getattr(fn, "__code__", None)
         if code is None:

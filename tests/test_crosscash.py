@@ -8,6 +8,8 @@ run the whole loop: seeded traffic, prediction, gather, convergence — and
 prove the checker actually detects an injected lost-update divergence.
 """
 
+import pytest
+
 from corda_tpu.tools.crosscash import (
     CrossCashCommand,
     CrossCashModel,
@@ -43,6 +45,7 @@ def test_generate_wave_respects_balances():
             m.apply(cmd)
 
 
+@pytest.mark.slow
 def test_crosscash_converges_simple_notary(tmp_path):
     r = run_crosscash(n_waves=3, wave_size=2, clients=2, notary="simple",
                       seed=11, base_dir=str(tmp_path))
@@ -50,6 +53,7 @@ def test_crosscash_converges_simple_notary(tmp_path):
     assert r.converged, (r.expected, r.gathered)
 
 
+@pytest.mark.slow
 def test_crosscash_detects_injected_lost_update(tmp_path):
     # The fault-injection hook drops one committed pay from the model: the
     # cluster is fine but the PREDICTION diverges — exactly the shape a
@@ -61,6 +65,7 @@ def test_crosscash_detects_injected_lost_update(tmp_path):
     assert not r.converged
 
 
+@pytest.mark.slow
 def test_crosscash_converges_under_kill_sigstop_strain(tmp_path):
     # The reference's full disruption inventory in one seeded run against a
     # 3-member Raft cluster: SIGKILL+restart, SIGSTOP hang, and CPU strain

@@ -6,6 +6,8 @@ aged max_wait_ms — so throughput gets wide batches under load while p99
 notarisation latency stays bounded when traffic is sparse.
 """
 
+import pytest
+
 import time
 
 from corda_tpu.node.config import BatchConfig
@@ -55,6 +57,7 @@ def test_disruption_kill_and_rebuild_converges(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_multiprocess_firehose_happy_path(tmp_path):
     from corda_tpu.tools.loadtest import run_loadtest_multiprocess
 
@@ -71,6 +74,7 @@ def test_multiprocess_firehose_happy_path(tmp_path):
     assert r.p50_ms <= r.p99_ms
 
 
+@pytest.mark.slow
 def test_multiprocess_open_loop_pacing(tmp_path):
     # rate_tx_s pacing stretches the measured phase to ~n/rate even though
     # the cluster could finish faster closed-loop.
@@ -83,6 +87,7 @@ def test_multiprocess_open_loop_pacing(tmp_path):
     assert r.duration_s >= 0.7 * (30 / 20.0)
 
 
+@pytest.mark.slow
 def test_multiprocess_kill_follower_converges(tmp_path):
     # Disruption.kt:18-60 'kill' against a real 3-process Raft cluster:
     # a follower is SIGKILLed mid-firehose and restarted from disk; every
@@ -99,6 +104,7 @@ def test_multiprocess_kill_follower_converges(tmp_path):
     assert r.tx_rejected == 0
 
 
+@pytest.mark.slow
 def test_multiprocess_sigstop_follower_converges(tmp_path):
     # The 'hang' primitive: a follower is frozen (SIGSTOP) for 2s — sockets
     # stay open, peers see an unresponsive node — then resumed. Quorum

@@ -408,3 +408,181 @@ class TestTrustForgery:
             'class C:\n    __module__ = "math"\n', "<attachment>", "exec")
         with pytest.raises(SandboxViolation, match="identity name"):
             DeterministicSandbox()._vet_code(code, {})
+
+    def test_wraps_stamped_global_function_is_still_vetted(self):
+        # round-4 advisor (medium): functools is whitelisted, so
+        # @functools.wraps(math.floor) stamps __module__='math' onto a user
+        # function. When a contract's verify reaches it via globals,
+        # _vet_value must NOT return on the bare string — the body must be
+        # vetted (and here rejected for open()).
+        import functools
+        import math
+
+        @functools.wraps(math.floor)
+        def evil(x):
+            return open("/etc/passwd")
+
+        assert evil.__module__ == "math"  # the forgery "took"
+
+        def verify(self, tx):
+            return evil(1)
+
+        verify.__globals__["evil"] = evil
+        try:
+            with pytest.raises(SandboxViolation, match="open"):
+                DeterministicSandbox().vet(verify)
+        finally:
+            del verify.__globals__["evil"]
+
+    def test_wraps_stamped_function_is_still_confined(self):
+        # Same forgery against _confine's platform exemption: the confined
+        # runtime must see restricted builtins, not the real ones.
+        import functools
+        import math
+
+        @functools.wraps(math.floor)
+        def probe(x):
+            return __builtins__  # noqa: F821 — resolved at runtime
+
+        confined = DeterministicSandbox()._confine(probe)
+        assert confined is not probe  # not exempted as "platform"
+        assert "open" not in confined(0)
+
+    def test_forged_class_module_is_still_vetted(self):
+        # The class-side forgery: type() builds a class with any __module__
+        # without tripping the STORE_NAME identity check or STORE_ATTR. A
+        # stamped user class must not borrow platform trust in _vet_value.
+        Evil = type("Evil", (), {
+            "__module__": "math",
+            "attack": lambda self: open("/etc/passwd"),
+        })
+        assert Evil.__module__ == "math"  # the forgery "took"
+        with pytest.raises(SandboxViolation, match="open"):
+            DeterministicSandbox()._vet_value("Evil", Evil, "<test>")
+
+    def test_genuine_platform_builtin_is_trusted(self):
+        # round-4 advisor (low): builtins from whitelisted modules have no
+        # __globals__, so the identity check can never pass; ownership
+        # (module attribute is the function, or bound to the module) must
+        # trust them instead of raising 'not vettable'.
+        import math
+
+        sandbox = DeterministicSandbox()
+        sandbox.vet(math.floor)  # must not raise
+        sandbox._vet_value("floor", math.floor, "<test>")
+
+    def test_genuine_platform_class_and_instance_trusted(self):
+        import decimal
+
+        sandbox = DeterministicSandbox()
+        assert sandbox._trusted_class(decimal.Decimal)
+        sandbox._vet_value("D", decimal.Decimal, "<test>")
+        sandbox._vet_value("d", decimal.Decimal("1.5"), "<test>")
+
+    def test_builtin_type_alias_still_forbidden(self):
+        # review finding: an ALIAS of a forbidden builtin type must not
+        # launder through class-identity trust — memoryview is builtins-
+        # owned, but the name screen has to fire exactly as for the
+        # spelled-out name.
+        with pytest.raises(SandboxViolation, match="memoryview"):
+            DeterministicSandbox()._vet_value("mv", memoryview, "<test>")
+
+    def test_partial_over_builtin_rejected(self):
+        # review finding: functools.partial(open, ...) is an instance of a
+        # whitelisted-module class but holds a REAL builtin confinement
+        # can't strip; class identity alone must not trust instances.
+        import functools
+
+        p = functools.partial(open, "/etc/passwd")
+        with pytest.raises(SandboxViolation):
+            DeterministicSandbox()._vet_value("p", p, "<test>")
+
+    def test_mutable_container_global_rejected(self):
+        # review finding: a list/dict global is cross-replay mutable state;
+        # the instance-trust branch must not bless builtin containers.
+        for bad in ([], {}, set()):
+            with pytest.raises(SandboxViolation):
+                DeterministicSandbox()._vet_value("cache", bad, "<test>")
+
+    def test_frozen_dataclass_field_payload_is_vetted(self):
+        # review finding: a platform frozen dataclass with a field holding a
+        # real builtin is a smuggle — trusting the instance must vet fields.
+        from corda_tpu.contracts.structures import TransactionState
+
+        smuggle = TransactionState(data=open, notary=None)
+        with pytest.raises(SandboxViolation, match="X.data"):
+            DeterministicSandbox()._vet_value("X", smuggle, "<test>")
+        # Benign payloads still pass.
+        ok = TransactionState(data=123, notary=None)
+        DeterministicSandbox()._vet_value("X", ok, "<test>")
+
+    def test_tuple_smuggling_builtin_rejected(self):
+        # Same vector one level shallower: (open,)[0] from confined code.
+        with pytest.raises(SandboxViolation, match=r"T\[0\]"):
+            DeterministicSandbox()._vet_value("T", (open,), "<test>")
+        DeterministicSandbox()._vet_value("T", (1, "a", (2.0,)), "<test>")
+
+    def test_forged_builtins_module_instance_rejected(self):
+        # review finding: forging __module__="builtins" (instead of "math")
+        # must not slip a user callable instance through the old
+        # string-compare builtins branch.
+        Evil = type("Evil", (), {
+            "__module__": "builtins",
+            "__call__": lambda self: open("/etc/passwd"),
+        })
+        helper = Evil()
+        sandbox = DeterministicSandbox()
+        with pytest.raises(SandboxViolation):
+            sandbox._vet_value("helper", helper, "<test>")
+        # Genuine builtins-owned C callables still pass the identity walk.
+        sandbox._vet_value("length", len, "<test>")
+
+    def test_class_attribute_tuple_smuggle_rejected(self):
+        # review finding: `T = (open,)` as a CLASS attribute must be vetted
+        # element-wise exactly like a module-global tuple.
+        class Carrier:
+            T = (open,)
+
+            def verify(self, tx):
+                return Carrier.T[0]("/etc/passwd")
+
+        with pytest.raises(SandboxViolation):
+            DeterministicSandbox()._vet_class(Carrier, "<test>")
+
+    def test_forged_c_callable_surface_rejected(self):
+        # review finding: an instance forging __module__/__self__ as class
+        # attributes must not pass _trusted_home's ownership leg — only
+        # genuine C-callable types qualify.
+        import math
+
+        Evil = type("Evil", (), {
+            "__module__": "math",
+            "__self__": math,
+            "__call__": lambda self: open("/etc/passwd"),
+        })
+        x = Evil()
+        sandbox = DeterministicSandbox()
+        assert not sandbox._trusted_home(x)
+        with pytest.raises(SandboxViolation):
+            sandbox._vet_value("x", x, "<test>")
+
+
+class TestDataclassHash:
+    def test_fieldless_frozen_dataclass_hash_excused(self):
+        # round-4 advisor (low): a fieldless frozen dataclass generates
+        # __hash__ with co_consts == (None, ()) — hash of the empty field
+        # tuple — and must still pass the shape check.
+        from dataclasses import dataclass as dc
+
+        @dc(frozen=True)
+        class Marker:
+            pass
+
+        def verify(self, tx):
+            return Marker() in {Marker()}
+
+        verify.__globals__["Marker"] = Marker
+        try:
+            DeterministicSandbox().vet(verify)  # must not raise
+        finally:
+            del verify.__globals__["Marker"]
