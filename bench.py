@@ -273,9 +273,25 @@ def bench_notary_roundtrip(n_flows=64, verifier=None):
             "p50_ms": round(1e3 * lat[len(lat) // 2], 2),
             "p99_ms": round(
                 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+            **_verifier_stamp(verifier),
         }
     finally:
         set_verifier(None)
+
+
+def _verifier_stamp(verifier) -> dict:
+    """Self-describing config stamp (round-4 verdict weak #4): every
+    framework number records WHICH verifier produced it, and — for jax
+    verifiers only — which kernel backend served the newest call (via
+    ops.last_backend_if_loaded, which never imports the kernel module
+    into a host-only run)."""
+    from corda_tpu.ops import last_backend_if_loaded
+
+    name = getattr(verifier, "name", type(verifier).__name__)
+    backend = None
+    if isinstance(name, str) and name.startswith("jax"):
+        backend = last_backend_if_loaded()
+    return {"verifier": name, "backend": backend}
 
 
 def _warm_verify_kernel():
@@ -417,7 +433,8 @@ def bench_trades(n_trades=6, verifier=None):
         dt = time.perf_counter() - t0
         return {"trades_per_sec": round(n_trades / dt, 2),
                 "trade_median_ms": round(
-                    1e3 * statistics.median(durations), 1)}
+                    1e3 * statistics.median(durations), 1),
+                **_verifier_stamp(verifier)}
     finally:
         set_verifier(None)
 
@@ -465,7 +482,8 @@ def bench_multisig(n_distinct=64, tile_to=2048, verifier=None):
     assert fulfilled == sum(1 for m, s in txs if len(s) == 3), fulfilled
     dt = _time_median(run, repeats=3)
     return {"sigs_per_sec": round(len(jobs) / dt, 1),
-            "tx_per_sec": round(len(txs) / dt, 1)}
+            "tx_per_sec": round(len(txs) / dt, 1),
+            **_verifier_stamp(verifier)}
 
 
 def bench_partial_merkle(n_cmds=8, repeats=2000):
@@ -500,27 +518,34 @@ def bench_partial_merkle(n_cmds=8, repeats=2000):
             "revealed_commands": n_cmds}
 
 
-def bench_raft_cluster(n_tx=1000, width=32):
+def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
+                       notary_device="cpu"):
     """BASELINE config 1 (raft-notary-demo) at BASELINE size: a real 3-node
     Raft notary cluster, every node its OWN OS process (own GIL, TCP
     sockets, sqlite), firehosed by two client processes running the
     width-N multisig FirehoseFlow (reference: LoadTest.kt:39-144's
-    remote-nodes shape + NotaryDemo.kt:14-29). Node processes run the host
-    (OpenSSL) crypto path: the one tunnel TPU cannot be shared by five
-    processes, so this config measures the FRAMEWORK's sustained pipeline —
-    loadtest_sigs_per_sec counts every pump verification across the client
-    processes via RPC metric deltas."""
+    remote-nodes shape + NotaryDemo.kt:14-29). Client/follower processes
+    run the host (OpenSSL) crypto path — the one tunnel TPU cannot be
+    shared by five processes — but with notary_device="accelerator" the
+    FIRST raft member (the usual leader) owns the real device: the
+    production topology, with the TPU inside the measurement.
+    loadtest_sigs_per_sec counts every pump verification across client AND
+    notary processes via RPC metric deltas; node_stamps says which
+    verifier/backend each member actually ran."""
     from corda_tpu.tools.loadtest import run_loadtest_multiprocess
 
     res = run_loadtest_multiprocess(
         n_tx=n_tx, width=width, clients=2, notary="raft",
-        verifier="cpu", max_seconds=420.0)
+        verifier=verifier, client_verifier="cpu",
+        notary_device=notary_device, max_seconds=420.0)
     return {"harness": "multiprocess-driver", "n_tx": n_tx, "width": width,
             "tx_per_sec": res.tx_per_sec,
             "loadtest_sigs_per_sec": res.sigs_per_sec,
             "sigs_verified": res.sigs_verified,
             "committed": res.tx_committed,
-            "p50_ms": res.p50_ms, "p99_ms": res.p99_ms}
+            "p50_ms": res.p50_ms, "p99_ms": res.p99_ms,
+            "verifier": verifier, "notary_device": notary_device,
+            "node_stamps": res.node_stamps}
 
 
 def bench_resolve_ids(n_tx=2048, outputs_per_tx=8, host_only=False):
@@ -809,7 +834,21 @@ def _run_phases(report: dict) -> None:
     # stuck thread is deliberately leaked and the host-side configs still
     # get measured.
     report["phase"] = "device_init"
-    device = _device_init_with_timeout(300.0)
+    # Bounded backoff ACROSS a flap: the relay has been observed to answer
+    # a probe and then wedge the very next init, so one failed leash does
+    # not prove the tunnel is down for the whole run. Attempts × leash stay
+    # well under the run watchdog (default 2700 s).
+    import os as _os
+    init_attempts = max(1, int(_os.environ.get(
+        "CORDA_TPU_DEVICE_INIT_RETRIES", "2")))
+    device = None
+    for attempt in range(init_attempts):
+        device = _device_init_with_timeout(300.0 if attempt == 0 else 150.0)
+        if device is not None:
+            break
+        if attempt + 1 < init_attempts:
+            report["device_init_retries"] = attempt + 1
+            time.sleep(30.0)
     if device is None:
         _run_host_only_phases(report)
         return
@@ -840,7 +879,8 @@ def _run_phases(report: dict) -> None:
     # Per-BASELINE.json-config measurements (each small and bounded; config
     # 3 — the 100k synthetic firehose — IS the stream measurement below).
     configs = report["baseline_configs"] = {}
-    for name, fn in (("raft_notary_3node", bench_raft_cluster),
+    for name, fn in (("raft_notary_3node", lambda: bench_raft_cluster(
+                         verifier="jax", notary_device="accelerator")),
                      ("open_loop_latency", bench_open_loop_latency),
                      ("resolve_ids", bench_resolve_ids),
                      ("trader_dvp", bench_trades),

@@ -29,6 +29,8 @@ same results, reference speed.
 
 from __future__ import annotations
 
+import functools
+
 from . import ref_ed25519
 
 try:  # pragma: no cover - exercised implicitly by every test run
@@ -64,10 +66,19 @@ def available() -> bool:
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
-    """RFC 8032 signature, bit-identical to ref_ed25519.sign."""
+    """RFC 8032 signature, bit-identical to ref_ed25519.sign. The parsed
+    OpenSSL key object is memoised per seed: key parsing was measured at
+    ~20% of a width-32 multi-sig build (one from_private_bytes per
+    signature), and a loadgen client signs with the same handful of keys
+    thousands of times."""
     if _AVAILABLE and len(seed) == 32:
-        return Ed25519PrivateKey.from_private_bytes(seed).sign(bytes(msg))
+        return _private_key_cached(bytes(seed)).sign(bytes(msg))
     return ref_ed25519.sign(seed, msg)
+
+
+@functools.lru_cache(maxsize=4096)
+def _private_key_cached(seed: bytes):
+    return Ed25519PrivateKey.from_private_bytes(seed)
 
 
 def public_key(seed: bytes) -> bytes:
@@ -82,12 +93,24 @@ def public_key(seed: bytes) -> bytes:
 
 
 def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
-    """Oracle-equivalent verification: fast accepts, authoritative rejects."""
+    """Oracle-equivalent verification: fast accepts, authoritative rejects.
+
+    The parsed public-key object is memoised: a node re-verifies the same
+    small signer set all day (a width-32 multisig re-parses 32 keys per
+    transaction), and from_public_bytes was measured at a large share of
+    host verify cost under load."""
     if _AVAILABLE and len(pubkey) == 32 and len(sig) == 64:
         try:
-            Ed25519PublicKey.from_public_bytes(bytes(pubkey)).verify(
-                bytes(sig), bytes(msg))
+            _public_key_cached(bytes(pubkey)).verify(bytes(sig), bytes(msg))
             return True  # OpenSSL-accept is a subset of oracle-accept
         except Exception:
             pass  # genuinely bad, or an oracle-only corner — ask the oracle
     return ref_ed25519.verify(pubkey, msg, sig)
+
+
+@functools.lru_cache(maxsize=65536)
+def _public_key_cached(pk: bytes):
+    # Raises on a malformed key: lru_cache does not cache exceptions, and
+    # verify()'s except-path hands those to the oracle for the
+    # authoritative reject.
+    return Ed25519PublicKey.from_public_bytes(pk)

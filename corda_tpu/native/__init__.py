@@ -26,22 +26,26 @@ def _src_digest(src: pathlib.Path) -> str:
     return hashlib.sha256(src.read_bytes()).hexdigest()
 
 
-def load_ccodec():
-    """Import the native codec core, building it on first use. Returns the
-    module or None (no compiler, build failure, or CORDA_TPU_NO_NATIVE).
+def _load_native(name: str, link_args: tuple = ()):
+    """Import a native module from this package, building it on first use.
+    Returns the module or None (no compiler, build failure, or
+    CORDA_TPU_NO_NATIVE).
 
-    Freshness: the wire format is consensus-critical, so a stale build must
-    never shadow an updated `_ccodec.c` — the built .so carries a sidecar
-    recording the source sha256, and any mismatch triggers a rebuild.
+    Freshness: these cores sit on consensus-critical paths, so a stale
+    build must never shadow updated C source — the built .so carries a
+    sidecar recording the source sha256, and any mismatch triggers a
+    rebuild. Builds go to a temp name and os.replace (atomic) so
+    concurrent builders (the driver spawns many node processes at once)
+    never load a half-written .so.
     """
     if os.environ.get("CORDA_TPU_NO_NATIVE"):
         return None
-    src = pathlib.Path(__file__).with_name("_ccodec.c")
+    src = pathlib.Path(__file__).with_name(name + ".c")
     if not src.exists():
         return None
     ext_suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    target = src.with_name("_ccodec" + ext_suffix)
-    stamp = src.with_name("_ccodec.src-sha256")
+    target = src.with_name(name + ext_suffix)
+    stamp = src.with_name(name + ".src-sha256")
     digest = _src_digest(src)
     if target.exists():
         try:
@@ -50,14 +54,9 @@ def load_ccodec():
             fresh = False
         if fresh:
             try:
-                from . import _ccodec
-
-                return _ccodec
+                return importlib.import_module(f"{__name__}.{name}")
             except ImportError:
                 pass  # broken artifact: rebuild below
-    # Build to a temp name and os.replace (atomic) so concurrent builders
-    # (the driver spawns many node processes at once) never load a
-    # half-written .so.
     include = sysconfig.get_paths()["include"]
     tmp = None
     try:
@@ -65,7 +64,7 @@ def load_ccodec():
         os.close(fd)
         subprocess.run(
             ["gcc", "-O2", "-fPIC", "-shared", f"-I{include}",
-             str(src), "-o", tmp],
+             str(src), "-o", tmp, *link_args],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, target)
         stamp.write_text(digest + "\n")
@@ -81,8 +80,36 @@ def load_ccodec():
     # invisible to this process (1s-mtime filesystems).
     importlib.invalidate_caches()
     try:
-        from . import _ccodec
-
-        return _ccodec
+        return importlib.import_module(f"{__name__}.{name}")
     except ImportError:
         return None
+
+
+def load_ccodec():
+    """The native codec decode/encode core (`_ccodec.c`, wired in by
+    corda_tpu/serialization/codec.py)."""
+    return _load_native("_ccodec")
+
+
+def _libcrypto_path():
+    """The installed libcrypto shared object, headers or not: this image
+    ships libcrypto.so.3 without the dev symlink, so the builder links
+    the versioned file directly."""
+    import glob
+
+    for pattern in ("/usr/lib/*/libcrypto.so", "/lib/*/libcrypto.so",
+                    "/usr/lib/*/libcrypto.so.*", "/lib/*/libcrypto.so.*",
+                    "/usr/lib/libcrypto.so*", "/usr/local/lib/libcrypto.so*"):
+        hits = sorted(glob.glob(pattern))
+        if hits:
+            return hits[0]
+    return None
+
+
+def load_cverify():
+    """The batched libcrypto Ed25519 verify core (`_cverify.c`, wired in
+    by corda_tpu/crypto/provider.py). None when libcrypto is absent."""
+    lib = _libcrypto_path()
+    if lib is None:
+        return None
+    return _load_native("_cverify", (lib,))

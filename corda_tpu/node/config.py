@@ -31,6 +31,12 @@ class BatchConfig:
 
     max_sigs: int = 4096
     max_wait_ms: float = 2.0
+    # Round coalescing: after the first inbound message wakes a round, keep
+    # draining for this long before processing. Each round costs a sqlite
+    # commit (fsync), an ACK frame per connection, and (on a raft leader)
+    # an AppendEntries broadcast — a small accumulation window amortises
+    # all three across the burst. 0 = wake-per-message (lowest latency).
+    coalesce_ms: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -103,6 +109,7 @@ class NodeConfig:
             batch=BatchConfig(
                 max_sigs=int(batch.get("max_sigs", 4096)),
                 max_wait_ms=float(batch.get("max_wait_ms", 2.0)),
+                coalesce_ms=float(batch.get("coalesce_ms", 0.0)),
             ),
             rpc_users=tuple(
                 dict(u) for u in raw.get("rpc_users", ())),

@@ -124,6 +124,15 @@ class _Outbox:
             return [(s, u, f) for s, p, u, f in self._mem
                     if p == peer and s > after_seq][:limit]
 
+    def has_live(self, peer: str) -> bool:
+        """Any row for `peer` that is NOT ACK-retired? The bridge's drain
+        check must use this, not count(): retired rows linger until the
+        node thread's flush_retired() delete, and a drain check that sees
+        them spins the replay loop at full CPU (observed ~10k sqlite
+        polls/s, starving the node thread's GIL) for up to a whole round
+        interval after every burst."""
+        return bool(self.pending_after(peer, 0, limit=1))
+
     def count(self, peer: str) -> int:
         """Pending-frame count WITHOUT materialising blobs (polled per
         heartbeat by consensus backpressure). May briefly overcount by the
@@ -439,7 +448,7 @@ class TcpMessaging(MessagingService):
                             raw, server_hostname=host)
                     with contextlib.closing(sock):
                         attempt = 0
-                        self._replay_outbox(peer, sock)
+                        self._replay_outbox(peer, sock, wakeup)
             except sqlite3.ProgrammingError:
                 return  # db closed mid-replay: the node is shutting down
             except OSError:
@@ -449,11 +458,16 @@ class TcpMessaging(MessagingService):
                 wakeup.clear()
                 wakeup.wait(timeout=backoff)
 
-    def _replay_outbox(self, peer: str, sock: socket.socket) -> None:
+    def _replay_outbox(self, peer: str, sock: socket.socket,
+                       wakeup: threading.Event | None = None) -> None:
         """Stream outbox frames and consume ACKs concurrently (no head-of-line
         blocking: frames enqueued while earlier ones await ACK still go out).
-        Returns when the outbox is empty; raises OSError to trigger
-        reconnect + redeliver when the peer stalls or drops.
+        Raises OSError to trigger reconnect + redeliver when the peer stalls
+        or drops. When the outbox drains, the connection is KEPT and the
+        loop parks on the wakeup event: tearing it down per burst was
+        measured at ~70 fresh TCP(+TLS) handshakes/s on a loaded raft
+        leader — handshake latency and accept-thread churn on both sides of
+        every hop.
 
         Frames are fetched INCREMENTALLY (seq > last sent) so steady-state
         polls touch only new rows; un-ACKed frames from this connection are
@@ -465,10 +479,23 @@ class TcpMessaging(MessagingService):
         while self._running:
             batch = self._outbox.pending_after(peer, last_seq)
             if not batch and not sent:
-                if self._outbox.count(peer) == 0:
-                    return  # truly drained (acks may have raced last_seq)
-                # Rows at/below last_seq remain un-ACKed from a PREVIOUS
-                # connection: resend them once from scratch.
+                # Clear BEFORE the liveness check: a frame enqueued (and
+                # the event set) between has_live() and clear() would be
+                # erased and sit undelivered until the fallback re-poll.
+                if wakeup is not None:
+                    wakeup.clear()
+                if not self._outbox.has_live(peer):
+                    # Drained: every remaining row is ACK-retired and only
+                    # awaits the node thread's delete. (count() would see
+                    # those rows and spin this loop at full CPU.) Park on
+                    # the wakeup with the connection warm; fall back to a
+                    # liveness re-check every second.
+                    if wakeup is None:
+                        return
+                    wakeup.wait(timeout=1.0)
+                    continue
+                # Live rows at/below last_seq remain un-ACKed from a
+                # PREVIOUS connection: resend them once from scratch.
                 last_seq = 0
                 sent.clear()
                 continue
@@ -617,25 +644,42 @@ class TcpMessaging(MessagingService):
     def remove_message_handler(self, registration: MessageHandlerRegistration) -> None:
         self._handlers.remove(registration)
 
-    def pump(self, timeout: float = 0.0, max_messages: int | None = None
-             ) -> int:
+    def pump(self, timeout: float = 0.0, max_messages: int | None = None,
+             coalesce: float = 0.0) -> int:
         """Dispatch queued inbound messages on THIS thread; ACK after
         processing. Returns number dispatched. timeout>0 blocks for the
         first message. max_messages bounds one pump call so a round (and its
         db transaction, which holds the sqlite write lock) stays short under
-        firehose load — leftover messages are dispatched next round."""
+        firehose load — leftover messages are dispatched next round.
+
+        coalesce>0: once the first message wakes the round, keep draining
+        (blocking) for up to that many seconds from its arrival — each
+        round costs a commit/fsync, per-connection ACK frames and (leader)
+        an AppendEntries broadcast, and wake-per-message pays all three per
+        message under trickle load."""
         self._outbox.flush_retired()  # node thread: the ONE sqlite writer
         n = attempts = 0
+        window_end = None
         while True:
             if max_messages is not None and attempts >= max_messages:
                 return n
-            first_blocking = n == 0 and timeout > 0
+            first_blocking = attempts == 0 and timeout > 0
+            if first_blocking:
+                block, wait = True, timeout
+            elif window_end is not None:
+                wait = window_end - time.monotonic()
+                if wait <= 0:
+                    block, wait = False, None
+                else:
+                    block = True
+            else:
+                block, wait = False, None
             try:
-                conn, message = self._inbound.get(
-                    block=first_blocking,
-                    timeout=timeout if first_blocking else None)
+                conn, message = self._inbound.get(block=block, timeout=wait)
             except queue.Empty:
                 return n
+            if attempts == 0 and coalesce > 0:
+                window_end = time.monotonic() + coalesce
             attempts += 1
             if self._dispatch(conn, message):
                 n += 1

@@ -19,6 +19,7 @@ Run it:  python -m corda_tpu.node.node <config.toml>
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -333,17 +334,30 @@ class Node:
             deadline = (self.smm.verify_waiting_since
                         + batch.max_wait_ms / 1e3)
             wait = max(0.0, min(timeout, deadline - time.monotonic()))
+        stages = self.smm.metrics.setdefault(
+            "round_stage_s", {"lock": 0.0, "pump": 0.0, "raft": 0.0,
+                              "services": 0.0, "verify": 0.0,
+                              "checkpoint": 0.0, "commit": 0.0, "rounds": 0})
+        t = time.perf_counter
+        t_pre = t()
         try:
             with self.db.batch():
-                n = self.messaging.pump(timeout=wait, max_messages=512)
+                t0 = t()
+                stages["lock"] += t0 - t_pre
+                n = self.messaging.pump(timeout=wait, max_messages=512,
+                                        coalesce=batch.coalesce_ms / 1e3)
+                t1 = t()
                 if self.raft_member is not None:
                     self.raft_member.tick()
+                t2 = t()
                 self.smm.poll_services()
+                t3 = t()
                 if self.raft_member is not None:
                     # poll_services may have submitted commits; replicate
                     # them in THIS round (one coalesced AppendEntries per
                     # peer).
                     self.raft_member.flush_appends()
+                t4 = t()
                 self.scheduler.tick()
                 pending = self.smm.verify_pending_sigs
                 if pending and (
@@ -352,12 +366,23 @@ class Node:
                     >= batch.max_wait_ms / 1e3
                 ):
                     self.smm.flush_pending_verifies()
+                t5 = t()
                 self.smm.flush_checkpoints()
                 if self.rpc is not None:
                     # Server-push: stream new change-feed events to RPC
                     # subscribers inside the round (the frames ride the
                     # durable outbox committed with it).
                     self.rpc.push_pending()
+                t6 = t()
+                # Stage accounting (cheap: 7 clock reads per round) is the
+                # attribution artifact for the process-boundary throughput
+                # work — exported via node_metrics like every counter.
+                stages["pump"] += t1 - t0
+                stages["raft"] += (t2 - t1) + (t4 - t3)
+                stages["services"] += t3 - t2
+                stages["verify"] += t5 - t4
+                stages["checkpoint"] += t6 - t5
+                stages["rounds"] += 1
         except BaseException:
             # The round rolled back: its deferred ACKs must not be sent
             # (senders redeliver) and in-memory flow state is now AHEAD of
@@ -367,6 +392,7 @@ class Node:
             if abort is not None:
                 abort()
             raise
+        stages["commit"] += t() - t6  # db.batch() exit = the round fsync
         flush = getattr(self.messaging, "flush_round", None)
         if flush is not None:
             flush()
@@ -407,10 +433,34 @@ def main(argv: list[str] | None = None) -> int:
     config = NodeConfig.load(argv[0])
     node = Node(config).start()
     print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
+    # Attribution hook: CORDA_TPU_NODE_PROFILE=<dir> dumps a cProfile of
+    # the whole run loop to <dir>/<name>.pstats on shutdown (SIGTERM
+    # included) — how the process-boundary throughput gap was measured.
+    profile_dir = os.environ.get("CORDA_TPU_NODE_PROFILE")
+    profiler = None
+    if profile_dir:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+
+        def _dump(signum=None, frame=None):
+            profiler.disable()
+            path = os.path.join(profile_dir, f"{config.name}.pstats")
+            try:
+                profiler.dump_stats(path)
+            finally:
+                if signum is not None:
+                    raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _dump)
     try:
         node.run_forever()
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, SystemExit):
         node.stop()
+    finally:
+        if profiler is not None:
+            _dump()
     return 0
 
 

@@ -151,9 +151,17 @@ class NodeRpcOps:
 
     def node_metrics(self) -> dict:
         smm = self._node.smm
+        # Self-describing verification stamps (round-4 verdict: trend lines
+        # silently changed meaning because nothing recorded WHICH verifier /
+        # kernel backend produced a number).
+        from ..ops import last_backend_if_loaded
+
+        kernel_backend = last_backend_if_loaded()
         return dict(smm.metrics) | {
             "flows_in_flight": smm.in_flight_count,
             "verify_pending_sigs": smm.verify_pending_sigs,
+            "verifier": getattr(smm.verifier, "name", None),
+            "kernel_backend": kernel_backend,
         }
 
 

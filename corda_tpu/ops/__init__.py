@@ -5,3 +5,46 @@ Batched field arithmetic (fe25519), Ed25519 signature verification
 per-signature JVM loops on the reference's notary hot path (reference:
 core/src/main/kotlin/net/corda/core/transactions/SignedTransaction.kt:83-87).
 """
+
+import os as _os
+import sys as _sys
+
+
+def last_backend_if_loaded():
+    """Which kernel backend ("pallas" | "xla" | None) served the newest
+    ed25519 verify call — read WITHOUT importing the kernel module. Every
+    stamping site (RPC node_metrics, bench config stamps) must use this:
+    stamping must never be the thing that pulls jax into a host-only
+    process, especially on a host whose accelerator tunnel can wedge."""
+    mod = _sys.modules.get("corda_tpu.ops.ed25519_jax")
+    if mod is None:
+        return None
+    try:
+        return mod.last_backend()
+    except Exception:
+        return None
+
+
+def enable_persistent_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a machine-local dir so
+    the kernel zoo compiles once per MACHINE, not once per process. Every
+    node process calls this lazily before its first kernel build: a cold
+    in-process compile of the Ed25519 graph stalls the node's run loop for
+    tens of seconds — long enough to trip RPC timeouts — and a 5-process
+    driver cluster would pay it five times over. Idempotent; disable by
+    setting CORDA_TPU_JAX_CACHE to an empty string."""
+    cache_dir = _os.environ.get("CORDA_TPU_JAX_CACHE")
+    if cache_dir is None:
+        # Per-uid default: a world-predictable shared /tmp path would let
+        # another local user plant compiled-code artifacts (and two users
+        # would collide on directory ownership anyway).
+        cache_dir = f"/tmp/corda_tpu_jax_cache_{_os.getuid()}"
+    if not cache_dir:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs: just compile in-process

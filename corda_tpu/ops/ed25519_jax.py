@@ -36,7 +36,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import enable_persistent_compile_cache
 from . import fe25519 as fe
+
+# Importing this module means kernels are coming: share compiled graphs
+# across processes (a driver cluster spawns five nodes; each would
+# otherwise pay the cold compile).
+enable_persistent_compile_cache()
 from ..crypto import ref_ed25519 as ref
 
 __all__ = ["verify_batch", "precompute_batch", "verify_arrays", "pick_bucket",

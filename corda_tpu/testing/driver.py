@@ -48,6 +48,7 @@ class NodeProcess:
     process: subprocess.Popen
     address: tuple[str, int] | None = None
     rpc_users: list = field(default_factory=list)
+    device: str = "cpu"  # "cpu" | "accelerator" — survives restart_node
 
     @property
     def log_path(self) -> Path:
@@ -149,6 +150,62 @@ class NodeProcess:
             self.process.wait(timeout=5)
 
 
+def render_node_config(name: str, node_dir, netmap, notary: str = "none",
+                       raft_cluster: tuple[str, ...] = (),
+                       cordapps: tuple[str, ...] = (),
+                       extra_toml: str = "",
+                       rpc_users: list | None = None) -> str:
+    """The node.toml the driver writes for a child. Ordering is
+    load-bearing: extra_toml goes BEFORE any [[rpc_users]] table — TOML
+    keys after a table header belong to that table, so a trailing
+    `verifier = ...` would silently become an rpc_users field and the node
+    would run the default verifier (observed: every RPC-enabled node
+    ignored its configured verifier)."""
+    lines = [
+        f"name = {_toml_escape(name)}",
+        f"base_dir = {_toml_escape(str(node_dir))}",
+        f"network_map = {_toml_escape(str(netmap))}",
+        f"notary = {_toml_escape(notary)}",
+    ]
+    if raft_cluster:
+        lines.append(
+            "raft_cluster = ["
+            + ", ".join(_toml_escape(n) for n in raft_cluster) + "]")
+    if cordapps:
+        lines.append(
+            "cordapps = ["
+            + ", ".join(_toml_escape(c) for c in cordapps) + "]")
+    if extra_toml:
+        lines.append(extra_toml)
+    for user in rpc_users or []:
+        lines.append("[[rpc_users]]")
+        lines.append(f"username = {_toml_escape(user['username'])}")
+        lines.append(f"password = {_toml_escape(user['password'])}")
+        lines.append("permissions = ["
+                     + ", ".join(_toml_escape(p)
+                                 for p in user["permissions"]) + "]")
+    return "\n".join(lines) + "\n"
+
+
+def _node_env(device: str) -> dict:
+    """Per-node device policy (the production topology: only the notary
+    process owns the accelerator; every other child stays on the host
+    path — one tunnel chip cannot be shared by five processes).
+
+    * "cpu": pin the child to the host platform.
+    * "accelerator": strip any inherited platform pin / virtual-mesh flags
+      so the child initialises the real backend lazily, on its first
+      verify batch (node startup never blocks on a wedged tunnel).
+    """
+    env = dict(os.environ)
+    if device == "accelerator":
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+    else:
+        env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
 class Driver:
     def __init__(self, base_dir: Path):
         self.base_dir = Path(base_dir)
@@ -159,38 +216,18 @@ class Driver:
     def start_node(self, name: str, notary: str = "none",
                    cordapps: tuple[str, ...] = (), rpc: bool = False,
                    raft_cluster: tuple[str, ...] = (),
-                   wait: bool = True, extra_toml: str = "") -> NodeProcess:
+                   wait: bool = True, extra_toml: str = "",
+                   device: str = "cpu") -> NodeProcess:
         node_dir = self.base_dir / name
         node_dir.mkdir(parents=True, exist_ok=True)
-        lines = [
-            f"name = {_toml_escape(name)}",
-            f"base_dir = {_toml_escape(str(node_dir))}",
-            f"network_map = {_toml_escape(str(self.netmap))}",
-            f"notary = {_toml_escape(notary)}",
-        ]
-        if raft_cluster:
-            lines.append(
-                "raft_cluster = ["
-                + ", ".join(_toml_escape(n) for n in raft_cluster) + "]")
-        if cordapps:
-            lines.append(
-                "cordapps = ["
-                + ", ".join(_toml_escape(c) for c in cordapps) + "]")
         rpc_users = [DEFAULT_RPC_USER] if rpc else []
-        for user in rpc_users:
-            lines.append("[[rpc_users]]")
-            lines.append(f"username = {_toml_escape(user['username'])}")
-            lines.append(f"password = {_toml_escape(user['password'])}")
-            lines.append("permissions = ["
-                         + ", ".join(_toml_escape(p)
-                                     for p in user["permissions"]) + "]")
-        if extra_toml:
-            lines.append(extra_toml)
         config_path = node_dir / "node.toml"
-        config_path.write_text("\n".join(lines) + "\n")
+        config_path.write_text(render_node_config(
+            name=name, node_dir=node_dir, netmap=self.netmap, notary=notary,
+            raft_cluster=raft_cluster, cordapps=cordapps,
+            extra_toml=extra_toml, rpc_users=rpc_users))
 
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")  # node processes don't need TPU
+        env = _node_env(device)
         log = open(node_dir / "node.log", "ab")
         process = subprocess.Popen(
             [sys.executable, "-m", "corda_tpu.node.node", str(config_path)],
@@ -198,7 +235,7 @@ class Driver:
             cwd="/root/repo", env=env)
         log.close()  # the child owns the fd now
         handle = NodeProcess(name, node_dir, config_path, process,
-                             rpc_users=rpc_users)
+                             rpc_users=rpc_users, device=device)
         self.nodes.append(handle)
         if wait:
             handle.wait_up()
@@ -208,8 +245,7 @@ class Driver:
                      wait: bool = True) -> NodeProcess:
         """Re-spawn a (killed) node over its existing base_dir + config —
         rebirth purely from disk (the kill/restart Disruption primitive)."""
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        env = _node_env(handle.device)
         log = open(handle.base_dir / "node.log", "ab")
         process = subprocess.Popen(
             [sys.executable, "-m", "corda_tpu.node.node",
@@ -218,7 +254,8 @@ class Driver:
             cwd="/root/repo", env=env)
         log.close()
         reborn = NodeProcess(handle.name, handle.base_dir, handle.config_path,
-                             process, rpc_users=handle.rpc_users)
+                             process, rpc_users=handle.rpc_users,
+                             device=handle.device)
         self.nodes.append(reborn)
         if wait:
             reborn.wait_up()

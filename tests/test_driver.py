@@ -76,3 +76,25 @@ def test_kill_notary_process_and_restart(tmp_path):
             assert len(tx_id) == 64
         finally:
             client.close()
+
+
+def test_rendered_config_keeps_extra_toml_top_level(tmp_path):
+    # Regression: extra_toml appended AFTER [[rpc_users]] made `verifier`
+    # an rpc_users field — every RPC-enabled node silently ran the default
+    # verifier. The rendered config must parse with the knob top-level.
+    from corda_tpu.node.config import NodeConfig
+    from corda_tpu.testing.driver import DEFAULT_RPC_USER, render_node_config
+
+    text = render_node_config(
+        name="N", node_dir=tmp_path, netmap=tmp_path / "netmap.json",
+        cordapps=("corda_tpu.tools.loadgen",),
+        extra_toml='verifier = "jax"\n[batch]\nmax_sigs = 4096\n'
+                   "max_wait_ms = 2.0\n",
+        rpc_users=[DEFAULT_RPC_USER])
+    path = tmp_path / "node.toml"
+    path.write_text(text)
+    cfg = NodeConfig.load(str(path))
+    assert cfg.verifier == "jax"
+    assert cfg.batch.max_sigs == 4096
+    assert cfg.rpc_users and cfg.rpc_users[0]["username"] == "demo"
+    assert "verifier" not in cfg.rpc_users[0]

@@ -1,0 +1,62 @@
+"""Tunnel-watcher unit tier: report parsing and capture gating only — the
+probe/bench loop spawns real subprocesses and is exercised operationally,
+not in CI (the suite must never depend on tunnel liveness)."""
+
+import json
+
+from corda_tpu.tools import tunnel_watch
+
+
+def test_device_backed_gating():
+    assert not tunnel_watch.device_backed(None)
+    assert not tunnel_watch.device_backed({})
+    assert not tunnel_watch.device_backed({"device": "unavailable"})
+    assert tunnel_watch.device_backed({"device": "TPU v5e", "value": 1.0})
+
+
+def test_run_bench_parses_last_json_line(monkeypatch, tmp_path):
+    # bench prints exactly one JSON line, but warm-up chatter may precede
+    # it on stdout; the parser must take the last JSON-looking line.
+    bench = tmp_path / "fake_bench.py"
+    bench.write_text(
+        "print('warming caches...')\n"
+        "print('{\"metric\": \"verified_sigs_per_sec\", \"value\": 42.0, "
+        "\"device\": \"TPU\"}')\n")
+    report = tunnel_watch.run_bench(str(bench), timeout_s=150.0)
+    assert report == {"metric": "verified_sigs_per_sec", "value": 42.0,
+                      "device": "TPU"}
+    assert tunnel_watch.device_backed(report)
+
+
+def test_run_bench_none_on_garbage(tmp_path):
+    bench = tmp_path / "fake_bench.py"
+    bench.write_text("print('no json here')\n")
+    assert tunnel_watch.run_bench(str(bench), timeout_s=150.0) is None
+
+
+def test_capture_written_only_when_device_backed(tmp_path, monkeypatch):
+    out = tmp_path / "cap.json"
+    calls = {"probe": 0, "bench": 0}
+
+    def fake_probe(timeout_s):
+        calls["probe"] += 1
+        return True
+
+    reports = [
+        {"device": "unavailable", "value": 0.0},       # first: degraded
+        {"device": "TPU v5e", "value": 123456.0},      # then: real
+    ]
+
+    def fake_bench(path, timeout_s):
+        calls["bench"] += 1
+        return reports[calls["bench"] - 1]
+
+    monkeypatch.setattr(tunnel_watch, "probe_once", fake_probe)
+    monkeypatch.setattr(tunnel_watch, "run_bench", fake_bench)
+    monkeypatch.setattr(tunnel_watch.time, "sleep", lambda s: None)
+    rc = tunnel_watch.main([
+        "--out", str(out), "--interval", "0", "--consecutive", "2",
+        "--max-hours", "1"])
+    assert rc == 0
+    assert calls["bench"] == 2  # degraded report did NOT stop the watch
+    assert json.loads(out.read_text())["value"] == 123456.0
