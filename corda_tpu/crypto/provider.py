@@ -23,10 +23,11 @@ core/src/main/kotlin/net/corda/core/crypto/CryptoUtilities.kt:63-96; no
 pluggable SignatureScheme SPI at 0.7); secp256r1 appears ONLY in TLS/X.509
 plumbing (core/.../crypto/X509Utilities.kt:44-48). The seam nonetheless
 exists here: VerifyJob carries a `scheme` tag, mixed batches split by scheme
-(ed25519 → the batched kernel path, ecdsa-p256 → the host oracle in
-crypto/ref_ecdsa_p256.py) and recombine in order. A device ECDSA kernel can
-slot behind the same tag if a workload ever warrants it — today none does,
-so the host oracle is the honest implementation.
+(ed25519 → the batched kernel path, ecdsa-p256 → the OpenSSL host fast path
+in crypto/fast_ecdsa_p256.py, whose accept set is pinned to the oracle in
+crypto/ref_ecdsa_p256.py by an oracle-owned structural gate) and recombine
+in order. A device ECDSA kernel can slot behind the same tag if a workload
+ever warrants it — today none does.
 """
 
 from __future__ import annotations
@@ -57,10 +58,17 @@ class VerifyJob:
     scheme: str = "ed25519"
 
 
-def _dispatch_mixed(jobs: Sequence[VerifyJob], ed25519_fn) -> np.ndarray:
+def _dispatch_mixed(jobs: Sequence[VerifyJob], ed25519_fn,
+                    p256_fn=None) -> np.ndarray:
     """Split a mixed-scheme batch: the ed25519 subset goes to `ed25519_fn`
-    (each provider's batched path); ecdsa-p256 jobs verify on the host
-    oracle; unknown schemes reject. Results recombine in input order."""
+    (each provider's batched path); ecdsa-p256 jobs verify through
+    `p256_fn` (default: the OpenSSL fast path with oracle-exact semantics,
+    crypto/fast_ecdsa_p256.py); unknown schemes reject. Results recombine
+    in input order."""
+    if p256_fn is None:
+        from . import fast_ecdsa_p256
+
+        p256_fn = fast_ecdsa_p256.verify
     out = np.zeros(len(jobs), bool)
     ed_idx = [i for i, j in enumerate(jobs) if j.scheme == "ed25519"]
     if ed_idx:
@@ -69,9 +77,7 @@ def _dispatch_mixed(jobs: Sequence[VerifyJob], ed25519_fn) -> np.ndarray:
             out[i] = ed_ok[k]
     for i, job in enumerate(jobs):
         if job.scheme == "ecdsa-p256":
-            from . import ref_ecdsa_p256
-
-            out[i] = ref_ecdsa_p256.verify(job.pubkey, job.message, job.sig)
+            out[i] = p256_fn(job.pubkey, job.message, job.sig)
     return out
 
 
@@ -144,10 +150,12 @@ class OracleVerifier(BatchVerifier):
     name = "cpu-oracle"
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        from . import ref_ecdsa_p256
+
         return _dispatch_mixed(jobs, lambda ed: np.array(
             [ref_ed25519.verify(j.pubkey, j.message, j.sig) for j in ed],
             bool,
-        ))
+        ), p256_fn=ref_ecdsa_p256.verify)
 
 
 def _shadow_check(jobs: Sequence[VerifyJob], out: np.ndarray,

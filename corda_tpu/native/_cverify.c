@@ -127,7 +127,9 @@ static void run_jobs(job_t *jobs, Py_ssize_t n) {
         spans[t].lo = lo;
         spans[t].hi = hi;
         if (t < nthreads - 1 && hi < n) {
-            if (pthread_create(&tids[t], NULL, worker, &spans[t]) == 0) {
+            /* tids is compacted by success count, not span index: a failed
+             * create must not leave a hole the join loop would read. */
+            if (pthread_create(&tids[started], NULL, worker, &spans[t]) == 0) {
                 started++;
                 continue;
             }
