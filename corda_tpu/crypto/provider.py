@@ -174,18 +174,49 @@ def _shadow_check(jobs: Sequence[VerifyJob], out: np.ndarray,
                     f"kernel={bool(out[i])} oracle={want}")
 
 
+# Below this many ed25519 jobs a device round trip loses to the host path:
+# the kernel pads every batch to >=1024 lanes and pays ~ms of pack+dispatch
+# +readback per call (worse over the tunnel), while the native/OpenSSL host
+# tier verifies small batches in tens of microseconds. Measured on the v5e
+# tunnel host (see bench trader_dvp: 0.79 trades/s device-always vs ~120
+# host — each 2-6-sig flow batch paid the device tax). Overridable per
+# verifier or via CORDA_TPU_DEVICE_MIN_SIGS; 0 forces device-always.
+DEVICE_MIN_SIGS_DEFAULT = 512
+
+
+def _resolve_device_min_sigs(value: int | None) -> int:
+    """Shared constructor policy for the size crossover (JaxVerifier and
+    MeshVerifier): explicit argument wins, else CORDA_TPU_DEVICE_MIN_SIGS,
+    else the measured default."""
+    if value is not None:
+        return value
+    return int(os.environ.get(
+        "CORDA_TPU_DEVICE_MIN_SIGS", DEVICE_MIN_SIGS_DEFAULT))
+
+
 class JaxVerifier(BatchVerifier):
     """Batched JAX kernel with shadow-sampled oracle cross-checks.
 
     shadow_rate: fraction of results re-verified on the CPU oracle; a mismatch
     raises RuntimeError (divergence must never be silent).
+
+    Batches below device_min_sigs route to the HOST tier (same semantics:
+    CpuVerifier's accept-fast + oracle-authoritative path) — the per-batch
+    backend choice by size, mirroring hash_many_auto's crossover constant.
+    host_batches/device_batches count where work actually went so bench
+    stamps and node metrics can attribute every number.
     """
 
     name = "jax-batch"
 
-    def __init__(self, shadow_rate: float = 0.0, rng: random.Random | None = None):
+    def __init__(self, shadow_rate: float = 0.0,
+                 rng: random.Random | None = None,
+                 device_min_sigs: int | None = None):
         self.shadow_rate = shadow_rate
         self._rng = rng or random.Random(0)
+        self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
+        self.host_batches = 0
+        self.device_batches = 0
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         if not jobs:
@@ -193,6 +224,12 @@ class JaxVerifier(BatchVerifier):
         return _dispatch_mixed(jobs, self._verify_ed25519)
 
     def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        if len(jobs) < self.device_min_sigs:
+            # Host tier is oracle-exact by construction (CpuVerifier doc);
+            # no shadow sampling needed on this route.
+            self.host_batches += 1
+            return CpuVerifier._verify_ed25519_host(jobs)
+        self.device_batches += 1
         from ..ops import ed25519_jax
 
         out = ed25519_jax.verify_batch(
@@ -220,11 +257,15 @@ class MeshVerifier(BatchVerifier):
 
     def __init__(self, n_devices: int | None = None,
                  shadow_rate: float = 0.0,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None,
+                 device_min_sigs: int | None = None):
         self.n_devices = n_devices
         self.shadow_rate = shadow_rate
         self._rng = rng or random.Random(0)
         self._mesh = None
+        self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
+        self.host_batches = 0
+        self.device_batches = 0
 
     @property
     def mesh(self):
@@ -240,6 +281,12 @@ class MeshVerifier(BatchVerifier):
         return _dispatch_mixed(jobs, self._verify_ed25519)
 
     def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        if len(jobs) < self.device_min_sigs:
+            # Same size crossover as JaxVerifier: a mesh dispatch costs
+            # MORE per call than single-chip, so tiny batches stay host.
+            self.host_batches += 1
+            return CpuVerifier._verify_ed25519_host(jobs)
+        self.device_batches += 1
         from ..ops import sharded
 
         out = sharded.verify_batch_sharded(

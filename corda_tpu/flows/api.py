@@ -325,3 +325,12 @@ class FlowLogic:
     @property
     def run_id(self):
         return self.state_machine.run_id if self.state_machine else None
+
+    def record_transactions(self, txs) -> None:
+        """Store transactions WITH provenance: in addition to
+        ServiceHub.record_transactions, each tx is mapped to this flow's
+        run id in the provenance log (reference: ServiceHubInternal
+        recording into StateMachineRecordedTransactionMappingStorage.kt) —
+        flows should record through this, not the hub directly, so the
+        explorer can attribute ledger activity to protocol runs."""
+        self.service_hub.record_transactions(txs, run_id=self.run_id)

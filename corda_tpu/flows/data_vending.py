@@ -66,7 +66,7 @@ class NotifyTransactionHandler(FlowLogic):
             ResolveTransactionsFlow(request.tx, self.other_party),
             share_parent_sessions=True,
         )
-        self.service_hub.record_transactions([request.tx])
+        self.record_transactions([request.tx])
         return None
 
 

@@ -34,7 +34,7 @@ class BroadcastTransactionFlow(FlowLogic):
         self.participants = tuple(participants)
 
     def call(self):
-        self.service_hub.record_transactions([self.notarised_transaction])
+        self.record_transactions([self.notarised_transaction])
         msg = NotifyTxRequest(self.notarised_transaction)
         me = self.service_hub.my_identity
         for participant in self.participants:

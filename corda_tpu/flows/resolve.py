@@ -93,7 +93,7 @@ class ResolveTransactionsFlow(FlowLogic):
             yield self.verify_signatures_batched(dep_stx)
             ltx = dep_stx.tx.to_ledger_transaction(self.service_hub)
             ltx.verify()
-            self.service_hub.record_transactions([dep_stx])
+            self.record_transactions([dep_stx])
             results.append(ltx)
         return results
 

@@ -44,6 +44,7 @@ from .services.inmemory import (
 from .services.notary import SimpleNotaryService, ValidatingNotaryService
 from .services.persistence import (
     DBAttachmentStorage,
+    DBTransactionMappingStorage,
     DBCheckpointStorage,
     DBTransactionStorage,
     NodeDatabase,
@@ -124,6 +125,8 @@ class Node:
             storage_service=StorageService(
                 validated_transactions=DBTransactionStorage(self.db),
                 attachments=DBAttachmentStorage(self.db),
+                state_machine_recorded_transaction_mapping=(
+                    DBTransactionMappingStorage(self.db)),
             ),
             vault_service=NodeVaultService(
                 lambda: set(key_service.keys.keys())),
@@ -197,6 +200,15 @@ class Node:
         self.services.vault_service.subscribe(
             lambda update: self.smm.changes.append(
                 ("vault", len(update.consumed), len(update.produced))))
+        # Provenance mappings join the feed too (observers fire only on
+        # FRESH rows, so a restart replaying checkpointed flows does not
+        # re-announce mappings already durable in tx_mappings): push
+        # subscribers see which flow produced each transaction live
+        # (reference: CordaRPCOps.kt:86 stateMachineRecordedTransaction
+        # MappingStorage's observable half).
+        self.services.storage_service.state_machine_recorded_transaction_mapping \
+            .subscribe(lambda m: self.smm.changes.append(
+                ("tx_recorded", m.run_id, m.tx_id.bytes)))
         from .services.scheduler import NodeSchedulerService
         from .services.vault_observers import CashBalanceMetricsObserver
 

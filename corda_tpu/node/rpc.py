@@ -138,6 +138,15 @@ class NodeRpcOps:
         return self._node.services.storage_service.validated_transactions \
             .get_transaction(tx_id)
 
+    def state_machine_recorded_transaction_mapping(self) -> tuple:
+        """Snapshot of the flow-run → tx provenance log (reference:
+        CordaRPCOps.kt:86). The observable half rides the push stream as
+        ("tx_recorded", run_id, tx_id_bytes) change events — subscribe via
+        subscribe_changes for live updates, poll this for the full join."""
+        mapping = self._node.services.storage_service \
+            .state_machine_recorded_transaction_mapping
+        return tuple(mapping.mappings()) if mapping is not None else ()
+
     # -- network -----------------------------------------------------------
 
     def network_map_snapshot(self) -> tuple:
@@ -162,6 +171,12 @@ class NodeRpcOps:
             "verify_pending_sigs": smm.verify_pending_sigs,
             "verifier": getattr(smm.verifier, "name", None),
             "kernel_backend": kernel_backend,
+            # Size-crossover routing (JaxVerifier/MeshVerifier): how many
+            # batches actually went to the device vs the host tier.
+            "verify_device_batches": getattr(
+                smm.verifier, "device_batches", None),
+            "verify_host_batches": getattr(
+                smm.verifier, "host_batches", None),
         }
 
 

@@ -202,13 +202,45 @@ class TransactionStorage:
         raise NotImplementedError
 
 
+@register
+@dataclass(frozen=True)
+class StateMachineTransactionMapping:
+    """Which flow produced/recorded which transaction (reference:
+    core/.../node/services/StateMachineRecordedTransactionMappingStorage.kt
+    and the StateMachineTransactionMapping pair in Services.kt) — the join
+    the explorer's transaction view uses to attribute ledger activity to
+    the protocol run that caused it."""
+
+    run_id: bytes
+    tx_id: SecureHash
+
+
+class TransactionMappingStorage:
+    """Flow-run → transaction provenance log (reference:
+    StateMachineRecordedTransactionMappingStorage.kt). Append-only and
+    deduplicated on (run_id, tx_id): checkpoint-replayed flows re-record
+    their transactions, which must not duplicate history or re-notify."""
+
+    def add_mapping(self, run_id: bytes, tx_id: SecureHash) -> None:
+        raise NotImplementedError
+
+    def mappings(self) -> list[StateMachineTransactionMapping]:
+        """Every recorded mapping in insertion order."""
+        raise NotImplementedError
+
+    def subscribe(self, observer: Callable) -> None:
+        """observer(mapping) fires once per FRESH mapping."""
+        raise NotImplementedError
+
+
 @dataclass
 class StorageService:
     """Bundle of storage sub-services (reference: Services.kt:226-259)."""
 
     validated_transactions: TransactionStorage
     attachments: AttachmentStorage
-    state_machine_recorded_transaction_mapping: Any = None
+    state_machine_recorded_transaction_mapping: (
+        TransactionMappingStorage | None) = None
 
 
 # ---------------------------------------------------------------------------
@@ -342,16 +374,27 @@ class ServiceHub:
             return None
         return stx.tx.outputs[ref.index]
 
-    def record_transactions(self, txs) -> None:
+    def record_transactions(self, txs, run_id: bytes | None = None) -> None:
         """Store + vault-notify observed transactions (ServiceHub.kt:38-46).
 
         Idempotent: transactions already in durable storage are skipped, so
         checkpoint-replayed flows re-recording a dependency cannot resurrect
-        vault states that a later transaction already consumed."""
+        vault states that a later transaction already consumed.
+
+        `run_id` (when the caller is a flow — FlowLogic.record_transactions
+        passes its own) lands each tx in the provenance log, the reference's
+        StateMachineRecordedTransactionMappingStorage capability. Mapped for
+        EVERY tx passed, not just fresh ones: a flow that records an
+        already-known dependency still touched it, and the mapping store
+        dedupes (run_id, tx_id) itself."""
         storage = self.storage_service.validated_transactions
         fresh = [stx for stx in txs if storage.get_transaction(stx.id) is None]
         for stx in fresh:
             storage.add_transaction(stx)
+        mapping = self.storage_service.state_machine_recorded_transaction_mapping
+        if mapping is not None and run_id is not None:
+            for stx in txs:
+                mapping.add_mapping(run_id, stx.id)
         if fresh:
             self.vault_service.notify_all(fresh)
 

@@ -29,6 +29,8 @@ from .api import (
     KeyManagementService,
     NetworkMapCache,
     NodeInfo,
+    StateMachineTransactionMapping,
+    TransactionMappingStorage,
     TransactionStorage,
     UniquenessConflict,
     UniquenessException,
@@ -110,6 +112,33 @@ class InMemoryTransactionStorage(TransactionStorage):
 
     def __len__(self):
         return len(self._txs)
+
+
+class InMemoryTransactionMappingStorage(TransactionMappingStorage):
+    """Flow-run → tx provenance log (reference:
+    node/.../services/transactions/InMemoryStateMachineRecordedTransaction
+    MappingStorage capability, via the Services.kt interface)."""
+
+    def __init__(self):
+        self._mappings: list[StateMachineTransactionMapping] = []
+        self._seen: set[tuple[bytes, SecureHash]] = set()
+        self._observers: list[Callable] = []
+
+    def add_mapping(self, run_id: bytes, tx_id: SecureHash) -> None:
+        key = (bytes(run_id), tx_id)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        mapping = StateMachineTransactionMapping(bytes(run_id), tx_id)
+        self._mappings.append(mapping)
+        for obs in list(self._observers):
+            obs(mapping)
+
+    def mappings(self) -> list[StateMachineTransactionMapping]:
+        return list(self._mappings)
+
+    def subscribe(self, observer: Callable) -> None:
+        self._observers.append(observer)
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,8 @@ from ..statemachine import CheckpointStorage
 from .api import (
     AttachmentStorage,
     ConsumingTx,
+    StateMachineTransactionMapping,
+    TransactionMappingStorage,
     TransactionStorage,
     UniquenessConflict,
     UniquenessException,
@@ -51,6 +53,11 @@ class NodeDatabase:
     CREATE TABLE IF NOT EXISTS transactions (
         tx_id BLOB PRIMARY KEY,
         blob  BLOB NOT NULL
+    );
+    CREATE TABLE IF NOT EXISTS tx_mappings (
+        run_id BLOB NOT NULL,
+        tx_id  BLOB NOT NULL,
+        PRIMARY KEY (run_id, tx_id)
     );
     CREATE TABLE IF NOT EXISTS attachments (
         att_id BLOB PRIMARY KEY,
@@ -286,6 +293,42 @@ class DBTransactionStorage(TransactionStorage):
     def __len__(self):
         (n,) = self._db.conn.execute(
             "SELECT COUNT(*) FROM transactions").fetchone()
+        return n
+
+
+class DBTransactionMappingStorage(TransactionMappingStorage):
+    """Durable flow-run → tx provenance log (reference:
+    node/.../persistence per-node DB tier of StateMachineRecordedTransaction
+    MappingStorage.kt). Writes ride the node thread's round batch like every
+    other store mutation; (run_id, tx_id) is the primary key, so checkpoint
+    replay re-records are no-ops and observers fire once per fresh row."""
+
+    def __init__(self, db: NodeDatabase):
+        self._db = db
+        self._observers: list[Callable] = []
+
+    def add_mapping(self, run_id: bytes, tx_id: SecureHash) -> None:
+        cur = self._db.conn.execute(
+            "INSERT OR IGNORE INTO tx_mappings (run_id, tx_id) VALUES (?, ?)",
+            (bytes(run_id), tx_id.bytes))
+        self._db.commit()
+        if cur.rowcount:
+            mapping = StateMachineTransactionMapping(bytes(run_id), tx_id)
+            for obs in list(self._observers):
+                obs(mapping)
+
+    def mappings(self) -> list[StateMachineTransactionMapping]:
+        rows = self._db.conn.execute(
+            "SELECT run_id, tx_id FROM tx_mappings ORDER BY rowid").fetchall()
+        return [StateMachineTransactionMapping(
+            bytes(r), SecureHash(bytes(t))) for r, t in rows]
+
+    def subscribe(self, observer: Callable) -> None:
+        self._observers.append(observer)
+
+    def __len__(self):
+        (n,) = self._db.conn.execute(
+            "SELECT COUNT(*) FROM tx_mappings").fetchone()
         return n
 
 

@@ -31,6 +31,7 @@ from ..node.services.api import (
 from ..node.services.inmemory import (
     InMemoryAttachmentStorage,
     InMemoryNetworkMapCache,
+    InMemoryTransactionMappingStorage,
     InMemoryTransactionStorage,
     InMemoryUniquenessProvider,
     InMemoryIdentityService,
@@ -85,6 +86,8 @@ class MockNode:
             storage_service=StorageService(
                 validated_transactions=InMemoryTransactionStorage(),
                 attachments=InMemoryAttachmentStorage(),
+                state_machine_recorded_transaction_mapping=(
+                    InMemoryTransactionMappingStorage()),
             ),
             vault_service=NodeVaultService(
                 lambda: set(key_service.keys.keys())

@@ -32,14 +32,14 @@ class IssueAndNotariseFlow(FlowLogic):
             me.ref(self.magic.to_bytes(4, "big")), self.magic, notary)
         builder.sign_with(self.service_hub.legal_identity_key)
         issue_stx = builder.to_signed_transaction()
-        self.service_hub.record_transactions([issue_stx])
+        self.record_transactions([issue_stx])
 
         move = DummyContract.move(issue_stx.tx.out_ref(0), me.owning_key)
         move.sign_with(self.service_hub.legal_identity_key)
         stx = move.to_signed_transaction(check_sufficient_signatures=False)
 
         sig = yield from self.sub_flow(NotaryClientFlow(stx))
-        self.service_hub.record_transactions([stx.with_additional_signature(sig)])
+        self.record_transactions([stx.with_additional_signature(sig)])
         return stx.id.hex()
 
     def _pick_notary(self):

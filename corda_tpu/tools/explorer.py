@@ -114,11 +114,32 @@ class ExplorerModel:
         # Transactions are immutable and content-addressed: fetch each hash
         # over RPC once, ever, instead of ~MAX_TX round trips per poll.
         self._tx_cache: dict = {}
+        # tx id (short hex) -> producing flow run ids; seeded once from the
+        # RPC snapshot, then maintained from pushed tx_recorded events.
+        self._provenance: dict = {}
+        self._provenance_seeded = False
+        self._provenance_gaps = 0
 
     def _on_pushed(self, events: tuple, cursor: int) -> None:
         self._events.extend(events)
         self._cursor = cursor
         del self._events[:-self.MAX_EVENTS]
+        # Provenance is maintained INCREMENTALLY from the pushed
+        # ("tx_recorded", run_id, tx_id) events: the tx_mappings log is
+        # append-only and unbounded, so re-polling the full snapshot every
+        # refresh would grow without limit (one snapshot seeds the view;
+        # push keeps it current; a detected push gap triggers re-seed).
+        for ev in events:
+            if ev and ev[0] == "tx_recorded":
+                self._add_provenance(bytes(ev[1]), bytes(ev[2]))
+
+    def _add_provenance(self, run_id: bytes, tx_id: bytes) -> None:
+        runs = self._provenance.setdefault(tx_id.hex()[:16], [])
+        short = run_id.hex()[:8]
+        if short not in runs:
+            runs.append(short)
+        while len(self._provenance) > 4 * self.MAX_TX:  # bound the view
+            self._provenance.pop(next(iter(self._provenance)))
 
     def _ensure_subscribed(self) -> None:
         import time as _time
@@ -140,6 +161,19 @@ class ExplorerModel:
         in_flight = rpc.call("state_machines_snapshot")
         metrics = rpc.call("node_metrics")
         rpc.poll_push()  # drain any pushed frames not seen during calls
+
+        # Flow→tx provenance join (reference: the explorer's transaction
+        # view joins flows to transactions through StateMachineRecorded
+        # TransactionMappingStorage): one full RPC snapshot seeds the
+        # view, then the pushed ("tx_recorded", ...) events keep it
+        # current (_on_pushed); a detected push gap re-seeds so evicted
+        # events cannot leave the join silently stale.
+        gaps = sum(rpc.push_gaps.values())
+        if not self._provenance_seeded or gaps != self._provenance_gaps:
+            for m in rpc.call("state_machine_recorded_transaction_mapping"):
+                self._add_provenance(m.run_id, m.tx_id.bytes)
+            self._provenance_seeded = True
+            self._provenance_gaps = gaps
 
         transactions = []
         seen = set()
@@ -172,6 +206,7 @@ class ExplorerModel:
             "balances": cash_balances(vault),
             "vault": render_value(vault),
             "transactions": render_value(transactions),
+            "tx_provenance": dict(self._provenance),
             "flows_in_flight": render_value(in_flight),
             "flow_events": render_value(self._events),
             "metrics": render_value(metrics),
@@ -196,6 +231,8 @@ _PAGE = """<!DOCTYPE html>
 <h2>Recent flow events</h2><pre id="events"></pre>
 <h2>Vault (unconsumed states)</h2><pre id="vault"></pre>
 <h2>Recent transactions</h2><pre id="txs"></pre>
+<h2>Transaction provenance <span class="muted">(tx id &rarr; producing flow
+run ids)</span></h2><table id="provenance"></table>
 <h2>Node metrics</h2><table id="metrics"></table>
 <script>
 function rows(el, pairs) {
@@ -229,6 +266,8 @@ async function refresh() {
       JSON.stringify(d.vault, null, 1);
   document.getElementById("txs").textContent =
       JSON.stringify(d.transactions, null, 1);
+  rows(document.getElementById("provenance"),
+       Object.entries(d.tx_provenance).map(p => [p[0], p[1].join(", ")]));
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>

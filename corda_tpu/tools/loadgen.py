@@ -107,7 +107,6 @@ class _Firehose:
 
     def _build_one(self, i: int):
         """Issue (recorded locally, as in NotaryDemo) + signed move."""
-        hub = self.flow.service_hub
         issue = TransactionBuilder(notary=self.notary)
         issue.add_output_state(
             DummyMultiOwnerState(i, self.owners))
@@ -116,7 +115,7 @@ class _Firehose:
         issue.sign_with(self.issuer)
         self.sigs_signed += 1
         issue_stx = issue.to_signed_transaction()
-        hub.record_transactions([issue_stx])
+        self.flow.record_transactions([issue_stx])  # with provenance
 
         move = TransactionBuilder(notary=self.notary)
         move.add_input_state(issue_stx.tx.out_ref(0))

@@ -316,3 +316,33 @@ def test_clean_venv_install_smoke(tmp_path):
     out = subprocess.run([str(py), "-c", probe], capture_output=True,
                          text=True, check=True, cwd=str(tmp_path), env=env)
     assert "install-ok" in out.stdout
+
+
+def test_jax_verifier_size_crossover_routing():
+    """Batches under device_min_sigs take the host tier (the device round
+    trip loses below ~512 sigs — measured crossover, provider.py
+    DEVICE_MIN_SIGS_DEFAULT); at/above it they take the kernel. Both
+    routes return identical verdicts and the counters attribute every
+    batch."""
+    from corda_tpu.crypto import ref_ed25519
+    from corda_tpu.crypto.provider import JaxVerifier, VerifyJob
+
+    jobs = []
+    for i in range(8):
+        seed = bytes([i + 1]) * 32
+        msg = (b"m%d" % i).ljust(32, b".")
+        sig = ref_ed25519.sign(seed, msg)
+        if i == 5:
+            sig = sig[:3] + bytes([sig[3] ^ 1]) + sig[4:]
+        jobs.append(VerifyJob(ref_ed25519.public_key(seed), msg, sig))
+    want = [i != 5 for i in range(8)]
+
+    v = JaxVerifier(device_min_sigs=8)
+    assert v.verify_batch(jobs[:3]).tolist() == want[:3]  # host route
+    assert (v.host_batches, v.device_batches) == (1, 0)
+    assert v.verify_batch(jobs).tolist() == want          # device route
+    assert (v.host_batches, v.device_batches) == (1, 1)
+
+    always_device = JaxVerifier(device_min_sigs=0)
+    assert always_device.verify_batch(jobs[:3]).tolist() == want[:3]
+    assert (always_device.host_batches, always_device.device_batches) == (0, 1)

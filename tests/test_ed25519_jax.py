@@ -168,8 +168,9 @@ def test_shadow_sampling_detects_kernel_divergence(monkeypatch):
     sig = ref.sign(sk, msg)
     jobs = [VerifyJob(pk, msg, sig)]
 
-    # Healthy kernel + shadow: passes.
-    ok = JaxVerifier(shadow_rate=1.0).verify_batch(jobs)
+    # device_min_sigs=0 pins the kernel route: a 1-job batch would
+    # otherwise take the host tier, which has no kernel to shadow.
+    ok = JaxVerifier(shadow_rate=1.0, device_min_sigs=0).verify_batch(jobs)
     assert ok.tolist() == [True]
 
     # Sabotage the kernel: flip every verdict. Shadow sampling must catch it.
@@ -177,7 +178,7 @@ def test_shadow_sampling_detects_kernel_divergence(monkeypatch):
     monkeypatch.setattr(ed25519_jax, "verify_batch",
                         lambda *a, **k: ~real(*a, **k))
     with pytest.raises(RuntimeError, match="divergence"):
-        JaxVerifier(shadow_rate=1.0).verify_batch(jobs)
+        JaxVerifier(shadow_rate=1.0, device_min_sigs=0).verify_batch(jobs)
 
 
 @pytest.mark.parametrize("depth", [1, 2, 4])

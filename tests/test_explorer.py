@@ -127,3 +127,46 @@ def test_demo_traffic_populates_vault(live_node):
             live_node.services.vault_service.current_vault.states)
     finally:
         traffic.stop()
+
+
+def test_dashboard_joins_tx_provenance(tmp_path):
+    """The tx view attributes ledger activity to the flow run that
+    produced it (reference: the explorer's GatheredTransactionData joins
+    flows to txs through StateMachineRecordedTransactionMappingStorage)."""
+    import time
+
+    import corda_tpu.tools.demo_cordapp  # noqa: F401  (registers the flow)
+    from corda_tpu.tools.explorer import ExplorerModel
+
+    node = Node(NodeConfig(
+        name="ProvExp", base_dir=tmp_path / "ProvExp",
+        network_map=tmp_path / "netmap.json", notary="simple",
+        rpc_users=RPC_USERS)).start()
+    stop = threading.Event()
+    pumper = threading.Thread(
+        target=lambda: [node.run_once(timeout=0.01)
+                        for _ in iter(stop.is_set, True)], daemon=True)
+    pumper.start()
+    client = RpcClient(node.messaging.my_address, "ops", "pw")
+    try:
+        handle = client.call(
+            "start_flow_dynamic", "IssueAndNotariseFlow", (7,))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            done, _ = client.call("flow_result", handle.run_id)
+            if done:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("demo flow did not finish")
+        model = ExplorerModel(client)
+        dash = model.gather()
+        run_short = handle.run_id.hex()[:8]
+        attributed = [tx for tx, runs in dash["tx_provenance"].items()
+                      if run_short in runs]
+        assert len(attributed) == 2, dash["tx_provenance"]
+    finally:
+        client.close()
+        stop.set()
+        pumper.join(timeout=2)
+        node.stop()

@@ -132,3 +132,19 @@ def test_open_loop_latency_sweep(tmp_path):
     assert r.committed == 40
     assert r.p50_ms <= r.p90_ms <= r.p99_ms
     assert r.duration_s >= 0.6 * (40 / 40.0)
+
+
+@pytest.mark.slow
+def test_latency_sweep_raft_validating_cluster(tmp_path):
+    """Open-loop sweep against the FLAGSHIP config (3-member raft
+    VALIDATING cluster through real OS processes — round-4 VERDICT item 4:
+    BASELINE metric 2's p99 was only ever closed-loop for raft)."""
+    from corda_tpu.tools.loadtest import run_latency_sweep
+
+    sweep = run_latency_sweep(rates=(15.0,), n_tx=12, width=2,
+                              notary="raft-validating",
+                              base_dir=str(tmp_path), max_seconds=240.0)
+    r = sweep[15.0]
+    assert r.committed == 12
+    assert r.rejected == 0
+    assert r.p99_ms >= r.p50_ms > 0

@@ -1,0 +1,145 @@
+"""Flow→transaction provenance mapping (reference: core/.../node/services/
+StateMachineRecordedTransactionMappingStorage.kt; RPC exposure at
+node/.../messaging/CordaRPCOps.kt:86): every transaction a flow records is
+mapped to the flow's run id, durably, and the join is visible over RPC as
+a poll snapshot plus live ("tx_recorded", ...) push events.
+"""
+
+import threading
+
+import pytest
+
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.provider import CpuVerifier
+from corda_tpu.flows import FinalityFlow
+from corda_tpu.testing import DummyContract
+from corda_tpu.testing.mock_network import MockNetwork
+
+
+@pytest.fixture()
+def net():
+    network = MockNetwork(verifier=CpuVerifier())
+    yield network
+    network.stop_nodes()
+
+
+def _issue_and_finalise(net, node, notary_party, magic=3):
+    builder = DummyContract.generate_initial(
+        node.identity.ref(b"\x00"), magic, notary_party)
+    builder.sign_with(node.key)
+    stx = builder.to_signed_transaction()
+    handle = node.start_flow(FinalityFlow(stx, ()))
+    net.run_network()
+    handle.result.result()
+    return stx, handle
+
+
+def test_flow_recording_lands_in_mapping_storage(net):
+    notary = net.create_notary_node("Notary")
+    alice = net.create_node("Alice")
+    stx, handle = _issue_and_finalise(net, alice, notary.identity)
+
+    mapping = alice.services.storage_service \
+        .state_machine_recorded_transaction_mapping
+    got = {(m.run_id, m.tx_id) for m in mapping.mappings()}
+    assert (handle.run_id, stx.id) in got
+
+
+def test_mapping_dedupes_and_notifies_once():
+    from corda_tpu.node.services.inmemory import (
+        InMemoryTransactionMappingStorage,
+    )
+
+    storage = InMemoryTransactionMappingStorage()
+    seen = []
+    storage.subscribe(seen.append)
+    tx_id = SecureHash.sha256(b"tx")
+    storage.add_mapping(b"run-1", tx_id)
+    storage.add_mapping(b"run-1", tx_id)  # checkpoint replay re-record
+    storage.add_mapping(b"run-2", tx_id)  # a second flow touching the tx
+    assert len(storage.mappings()) == 2
+    assert len(seen) == 2
+    assert seen[0].run_id == b"run-1" and seen[0].tx_id == tx_id
+
+
+def test_db_mapping_survives_restart(tmp_path):
+    from corda_tpu.node.services.persistence import (
+        DBTransactionMappingStorage,
+        NodeDatabase,
+    )
+
+    path = tmp_path / "node.db"
+    db = NodeDatabase(path)
+    storage = DBTransactionMappingStorage(db)
+    tx_id = SecureHash.sha256(b"durable-tx")
+    storage.add_mapping(b"run-9", tx_id)
+    storage.add_mapping(b"run-9", tx_id)  # idempotent
+    db.close()
+
+    db2 = NodeDatabase(path)  # the rebirth
+    storage2 = DBTransactionMappingStorage(db2)
+    got = storage2.mappings()
+    assert [(m.run_id, m.tx_id) for m in got] == [(b"run-9", tx_id)]
+    db2.close()
+
+
+def test_mapping_over_rpc_poll_and_push(tmp_path):
+    """A real node: the RPC snapshot carries the mapping and the push
+    stream announces it live as a ("tx_recorded", run_id, tx_id) event."""
+    from corda_tpu.node.config import NodeConfig
+    from corda_tpu.node.node import Node
+    from corda_tpu.node.rpc import RpcClient
+
+    node = Node(NodeConfig(
+        name="Prov", base_dir=tmp_path / "Prov",
+        network_map=tmp_path / "netmap.json", notary="simple",
+        rpc_users=({"username": "ops", "password": "pw",
+                    "permissions": ["ALL"]},))).start()
+    stop = threading.Event()
+    pumper = threading.Thread(
+        target=lambda: [node.run_once(timeout=0.01)
+                        for _ in iter(stop.is_set, True)], daemon=True)
+    pumper.start()
+    client = RpcClient(node.messaging.my_address, "ops", "pw")
+    try:
+        import corda_tpu.tools.demo_cordapp  # noqa: F401  (registers the flow)
+
+        got: list = []
+        client.subscribe_changes(lambda events, cursor: got.extend(events))
+        handle = client.call(
+            "start_flow_dynamic", "IssueAndNotariseFlow", (41,))
+        import time
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            done, _ = client.call("flow_result", handle.run_id)
+            if done:
+                break
+            client.poll_push()
+            time.sleep(0.05)
+        else:
+            pytest.fail("demo flow did not finish")
+
+        snapshot = client.call("state_machine_recorded_transaction_mapping")
+        by_run = [m for m in snapshot if m.run_id == handle.run_id]
+        # DemoIssueAndMove records the issue and the notarised move.
+        assert len(by_run) == 2, snapshot
+        for m in by_run:
+            assert client.call("verified_transaction", m.tx_id) is not None
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            recorded = [e for e in got if e[0] == "tx_recorded"]
+            if len(recorded) >= 2:
+                break
+            client.poll_push()
+            time.sleep(0.05)
+        recorded = [e for e in got if e[0] == "tx_recorded"]
+        assert {e[1] for e in recorded} == {handle.run_id}
+        assert {bytes(e[2]) for e in recorded} == {
+            m.tx_id.bytes for m in by_run}
+    finally:
+        client.close()
+        stop.set()
+        pumper.join(timeout=2)
+        node.stop()
