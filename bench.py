@@ -527,33 +527,38 @@ def bench_partial_merkle(n_cmds=8, repeats=2000):
 
 
 def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
-                       notary_device="cpu"):
+                       notary_device="cpu", notary="raft"):
     """BASELINE config 1 (raft-notary-demo) at BASELINE size: a real 3-node
-    Raft VALIDATING notary cluster — the reference demo's service type
-    (samples/raft-notary-demo/.../Main.kt:11 starts
-    RaftValidatingNotaryService; rounds 1-4 measured raft-simple, whose
-    notary never verifies a signature, so the device-owning member sat
-    idle) — every node its OWN OS process (own GIL, TCP sockets, sqlite),
-    firehosed by two client processes running the width-N multisig
-    FirehoseFlow (reference: LoadTest.kt:39-144's remote-nodes shape +
-    NotaryDemo.kt:14-29). Client/follower processes run the host (OpenSSL)
-    crypto path — the one tunnel TPU cannot be shared by five processes —
-    but with notary_device="accelerator" the FIRST raft member (the usual
-    leader) owns the real device: the production topology, with the TPU
-    inside the measurement. Under backlog the leader's verify pump
-    accumulates >= device_min_sigs and engages the kernel; light rounds
-    route to the host tier (size crossover, provider.py) — node_stamps +
-    the routing counters in node metrics attribute exactly where batches
-    went. loadtest_sigs_per_sec counts every pump verification across
-    client AND notary processes via RPC metric deltas."""
+    Raft notary cluster, every node its OWN OS process (own GIL, TCP
+    sockets, sqlite), firehosed by two client processes running the
+    width-N multisig FirehoseFlow (reference: LoadTest.kt:39-144's
+    remote-nodes shape + NotaryDemo.kt:14-29).
+
+    TWO configs report:
+      * raft_notary_3node — raft-SIMPLE, host crypto: the r1-r4 trend line
+        (a non-validating notary verifies no signatures itself, so the
+        clients' verification dominates).
+      * raft_validating_3node — raft-VALIDATING, the reference demo's
+        actual service type (samples/raft-notary-demo/.../Main.kt:11
+        starts RaftValidatingNotaryService), with
+        notary_device="accelerator": the FIRST member (the usual leader)
+        owns the real device — the production topology, with the TPU
+        inside the measurement. The node boot-warms the kernel behind a
+        host-gate (node.py _warm_verifier_maybe) so backend init/compile
+        never stalls the run loop; under backlog the leader's verify pump
+        accumulates >= device_min_sigs and engages the kernel, light
+        rounds route to the host tier — node_stamps + routing counters
+        attribute exactly where batches went.
+    loadtest_sigs_per_sec counts every pump verification across client
+    AND notary processes via RPC metric deltas."""
     from corda_tpu.tools.loadtest import run_loadtest_multiprocess
 
     res = run_loadtest_multiprocess(
-        n_tx=n_tx, width=width, clients=2, notary="raft-validating",
+        n_tx=n_tx, width=width, clients=2, notary=notary,
         verifier=verifier, client_verifier="cpu",
         notary_device=notary_device, max_seconds=420.0)
     return {"harness": "multiprocess-driver", "n_tx": n_tx, "width": width,
-            "notary": "raft-validating",
+            "notary": notary,
             "tx_per_sec": res.tx_per_sec,
             "loadtest_sigs_per_sec": res.sigs_per_sec,
             "sigs_verified": res.sigs_verified,
@@ -833,6 +838,8 @@ def _run_host_only_phases(report: dict) -> None:
     configs = report["baseline_configs"] = {}
     for name, fn in (
             ("raft_notary_3node", bench_raft_cluster),
+            ("raft_validating_3node", lambda: bench_raft_cluster(
+                n_tx=400, notary="raft-validating")),
             ("open_loop_latency", bench_open_loop_latency),
             ("raft_open_loop_latency", bench_raft_open_loop),
             ("resolve_ids", lambda: bench_resolve_ids(host_only=True)),
@@ -917,7 +924,9 @@ def _run_phases(report: dict) -> None:
     # Per-BASELINE.json-config measurements (each small and bounded; config
     # 3 — the 100k synthetic firehose — IS the stream measurement below).
     configs = report["baseline_configs"] = {}
-    for name, fn in (("raft_notary_3node", lambda: bench_raft_cluster(
+    for name, fn in (("raft_notary_3node", bench_raft_cluster),
+                     ("raft_validating_3node", lambda: bench_raft_cluster(
+                         n_tx=400, notary="raft-validating",
                          verifier="jax", notary_device="accelerator")),
                      ("open_loop_latency", bench_open_loop_latency),
                      ("raft_open_loop_latency", bench_raft_open_loop),

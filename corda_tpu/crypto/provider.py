@@ -217,6 +217,13 @@ class JaxVerifier(BatchVerifier):
         self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
         self.host_batches = 0
         self.device_batches = 0
+        # When a boot-time warm-up is in flight (node.py
+        # _warm_verifier_maybe sets this to its done-event), batches route
+        # to the host tier until it completes: the first kernel call in a
+        # process pays backend init + compile, and taking that hit inside
+        # the node run loop was measured stalling a notary ~100 s while
+        # closed-loop traffic queued. None (the default) means no gate.
+        self.device_gate = None
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         if not jobs:
@@ -224,7 +231,9 @@ class JaxVerifier(BatchVerifier):
         return _dispatch_mixed(jobs, self._verify_ed25519)
 
     def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        if len(jobs) < self.device_min_sigs:
+        if (len(jobs) < self.device_min_sigs
+                or (self.device_gate is not None
+                    and not self.device_gate.is_set())):
             # Host tier is oracle-exact by construction (CpuVerifier doc);
             # no shadow sampling needed on this route.
             self.host_batches += 1
@@ -237,6 +246,19 @@ class JaxVerifier(BatchVerifier):
         )
         _shadow_check(jobs, out, self.shadow_rate, self._rng)
         return out
+
+    def warm(self) -> None:
+        """Compile THIS verifier's device path at both pump bucket sizes
+        (pick_bucket ladder: light rounds pad to 1024, backlogged rounds
+        reach max_sigs=4096), bypassing the gate/size routing. Called by
+        the node's boot warm-up thread; blocking and exception-raising —
+        the caller owns gating and error policy."""
+        from ..ops import ed25519_jax
+
+        ed25519_jax.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
+        n = 1025  # > 1024 => the 4096 bucket's graphs
+        ed25519_jax.verify_batch([bytes(32)] * n, [bytes(32)] * n,
+                                 [bytes(64)] * n)
 
 
 class MeshVerifier(BatchVerifier):
@@ -266,6 +288,7 @@ class MeshVerifier(BatchVerifier):
         self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
         self.host_batches = 0
         self.device_batches = 0
+        self.device_gate = None  # same boot-warm gate as JaxVerifier
 
     @property
     def mesh(self):
@@ -281,7 +304,9 @@ class MeshVerifier(BatchVerifier):
         return _dispatch_mixed(jobs, self._verify_ed25519)
 
     def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        if len(jobs) < self.device_min_sigs:
+        if (len(jobs) < self.device_min_sigs
+                or (self.device_gate is not None
+                    and not self.device_gate.is_set())):
             # Same size crossover as JaxVerifier: a mesh dispatch costs
             # MORE per call than single-chip, so tiny batches stay host.
             self.host_batches += 1
@@ -294,6 +319,20 @@ class MeshVerifier(BatchVerifier):
             [j.sig for j in jobs], self.mesh)
         _shadow_check(jobs, out, self.shadow_rate, self._rng)
         return out
+
+    def warm(self) -> None:
+        """Compile the SHARDED graphs this verifier actually dispatches
+        (warming the single-chip kernel would open the gate without the
+        mesh path ever compiling). Same contract as JaxVerifier.warm."""
+        from ..ops import sharded
+
+        n_small = self.mesh.devices.size  # one lane per device, padded
+        sharded.verify_batch_sharded([bytes(32)] * n_small,
+                                     [bytes(32)] * n_small,
+                                     [bytes(64)] * n_small, self.mesh)
+        n = 1025
+        sharded.verify_batch_sharded([bytes(32)] * n, [bytes(32)] * n,
+                                     [bytes(64)] * n, self.mesh)
 
 
 _default: BatchVerifier | None = None

@@ -177,6 +177,18 @@ class NodeRpcOps:
                 smm.verifier, "device_batches", None),
             "verify_host_batches": getattr(
                 smm.verifier, "host_batches", None),
+            # Boot-warm gate state: True once the device kernel is warm,
+            # False while warm-up is in flight (batches host-route until
+            # then), None when no gate was installed (cpu verifier, or a
+            # process that never warms).
+            "verify_device_ready": (
+                smm.verifier.device_gate.is_set()
+                if getattr(smm.verifier, "device_gate", None) is not None
+                else None),
+            # Per-flow-name completion timings (count/total_ms/max_ms) —
+            # the per-flow half of the reference's JMX metrics export.
+            "flow_timings": {k: dict(v)
+                             for k, v in smm.flow_timings.items()},
         }
 
 

@@ -345,6 +345,7 @@ class FlowStateMachine:
         self.pending_value = None  # (kind, value) to feed into generator
         self._gen = None
         self._replay_cursor = 0
+        self.created_at = _time.monotonic()  # per-flow timing
         logic.state_machine = self
         logic.service_hub = manager.service_hub
 
@@ -748,6 +749,13 @@ class StateMachineManager:
         # Metrics (reference: StateMachineManager.kt:105-113)
         self.metrics = {"started": 0, "finished": 0, "checkpointing_rate": 0,
                         "verify_batches": 0, "verify_sigs": 0}
+        # Per-flow-name timing aggregates (the JMX/Jolokia capability the
+        # reference exports per-MBean, reference: Node.kt:313 — here over
+        # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
+        # flow class, recorded at completion. Bounded: a pathological
+        # stream of distinct flow names cannot grow it without limit.
+        self.flow_timings: dict[str, dict] = {}
+        self.FLOW_TIMINGS_MAX_NAMES = 256
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -796,6 +804,22 @@ class StateMachineManager:
     @property
     def in_flight_count(self) -> int:
         return len(self.flows)
+
+    def _record_flow_timing(self, fsm: "FlowStateMachine") -> None:
+        try:
+            name = fsm.logic._my_flow_name()
+        except Exception:
+            name = type(fsm.logic).__name__
+        timing = self.flow_timings.get(name)
+        if timing is None:
+            if len(self.flow_timings) >= self.FLOW_TIMINGS_MAX_NAMES:
+                return  # bounded; established names keep aggregating
+            timing = self.flow_timings[name] = {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0}
+        duration_ms = (_time.monotonic() - fsm.created_at) * 1e3
+        timing["count"] += 1
+        timing["total_ms"] = round(timing["total_ms"] + duration_ms, 3)
+        timing["max_ms"] = round(max(timing["max_ms"], duration_ms), 3)
 
     # -- checkpoint & restore ---------------------------------------------
 
@@ -1141,6 +1165,7 @@ class StateMachineManager:
         self._dirty_checkpoints.pop(fsm.run_id, None)
         self.checkpoint_storage.remove_checkpoint(fsm.run_id)
         self.metrics["finished"] += 1
+        self._record_flow_timing(fsm)
         # Bounded outcome cache so RPC clients can fetch results after the
         # flow leaves the registry (the reference returns a future over RPC).
         self.recent_results[fsm.run_id] = fsm.future

@@ -7,7 +7,10 @@ servlets/AttachmentDownloadServlet.kt, and the node-administration
 endpoints): a small threaded HTTP server exposing
 
   GET  /api/status                 -> {"name", "address", "flows_in_flight"}
-  GET  /api/metrics                -> the SMM metric registry
+  GET  /api/metrics                -> the SMM metric registry + per-flow
+                                      completion timings
+  GET  /api/metrics/history        -> bounded counters time-series (the
+                                      JMX/Jolokia capability, Node.kt:313)
   GET  /api/info                   -> identity + advertised services
   POST /upload/attachment          -> attachment id (content-addressed)
   GET  /attachments/<hex id>       -> the blob
@@ -72,7 +75,17 @@ class NodeWebServer:
                 "flows_in_flight": node.smm.in_flight_count,
             })
         elif path == "/api/metrics":
-            self._json(handler, dict(node.smm.metrics))
+            # dict() is one atomic C-level copy; iterating the live dict
+            # from this (webserver) thread while the node thread inserts
+            # a new flow name would raise mid-comprehension.
+            timings = dict(node.smm.flow_timings)
+            self._json(handler, dict(node.smm.metrics)
+                       | {"flow_timings": {k: dict(v)
+                                           for k, v in timings.items()}})
+        elif path == "/api/metrics/history":
+            # Bounded time-series ring sampled by the run loop (the
+            # JMX/Jolokia counters-over-time capability, Node.kt:313).
+            self._json(handler, list(node.metrics_history))
         elif path == "/api/info":
             self._json(handler, {
                 "legal_identity": node.identity.name,

@@ -8,6 +8,13 @@ is a `python -m corda_tpu.node.node <config.toml>` subprocess over real
 sockets and its own sqlite; the driver writes configs, waits for the "up at"
 banner, and exposes RPC handles and kill/restart for disruption tests.
 
+Node PLACEMENT goes through the Host seam (reference: the loadtest drives
+nodes on remote machines over SSH, tools/loadtest/.../ConnectionManager.kt):
+every file write, log read and process spawn is a Host method, so the
+harness never assumes localhost — LocalHost is the in-tree placement; an
+SSH host implements the same four methods to run the identical workload
+against a remote cluster.
+
 Usage:
     with driver(tmp_path) as d:
         notary = d.start_node("Notary", notary="simple")
@@ -30,6 +37,65 @@ DEFAULT_RPC_USER = {"username": "demo", "password": "s3cret",
                     "permissions": ["ALL"]}
 
 
+class Host:
+    """Node-placement seam (reference: tools/loadtest/src/main/kotlin/net/
+    corda/loadtest/ConnectionManager.kt — the loadtest drives nodes on
+    REMOTE hosts over SSH; LoadTest.kt:39-144 runs against them). A Host
+    provides file IO + process spawning on the machine that runs a node;
+    every Driver operation goes through it, so the harness itself never
+    assumes localhost. LocalHost is the in-tree implementation; an SSH twin
+    implements the same four methods over a remote connection (sftp for
+    files, remote exec returning a signal-capable handle) without touching
+    the Driver.
+
+    The handle returned by spawn() must provide the Popen subset the
+    driver's disruption primitives use: poll(), wait(timeout),
+    send_signal(sig), kill(), terminate(), returncode.
+    """
+
+    name = "abstract"
+
+    def mkdir(self, path) -> None:
+        raise NotImplementedError
+
+    def write_file(self, path, text: str) -> None:
+        raise NotImplementedError
+
+    def read_text(self, path) -> str:
+        """Contents of a (log) file; missing file raises OSError."""
+        raise NotImplementedError
+
+    def spawn(self, argv: list, log_path, cwd: str, env: dict):
+        raise NotImplementedError
+
+
+class LocalHost(Host):
+    """Runs node processes on this machine (the default placement)."""
+
+    name = "localhost"
+
+    def mkdir(self, path) -> None:
+        Path(path).mkdir(parents=True, exist_ok=True)
+
+    def write_file(self, path, text: str) -> None:
+        Path(path).write_text(text)
+
+    def read_text(self, path) -> str:
+        return Path(path).read_text(errors="replace")
+
+    def spawn(self, argv: list, log_path, cwd: str, env: dict):
+        # Output goes to a file, NOT a pipe: an undrained pipe would
+        # eventually block the node on a full buffer, and the log survives
+        # for post-mortem.
+        log = open(log_path, "ab")
+        try:
+            return subprocess.Popen(argv, stdout=log,
+                                    stderr=subprocess.STDOUT,
+                                    cwd=cwd, env=env)
+        finally:
+            log.close()  # the child owns the fd now
+
+
 def _toml_escape(v) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
@@ -45,34 +111,32 @@ class NodeProcess:
     name: str
     base_dir: Path
     config_path: Path
-    process: subprocess.Popen
+    process: object  # Host.spawn handle (Popen subset; see Host doc)
     address: tuple[str, int] | None = None
     rpc_users: list = field(default_factory=list)
     device: str = "cpu"  # "cpu" | "accelerator" — survives restart_node
+    host: Host = field(default_factory=LocalHost)
 
     @property
     def log_path(self) -> Path:
         return self.base_dir / "node.log"
 
     def wait_up(self, timeout: float = 60.0) -> "NodeProcess":
-        """Block until the node logs its startup banner; parse the port.
-        Output goes to base_dir/node.log (NOT a pipe: an undrained pipe
-        would eventually block the node on a full buffer, and the log
-        survives for post-mortem)."""
+        """Block until the node logs its startup banner; parse the port."""
         deadline = time.monotonic() + timeout
         prefix = f"node {self.name} up at "
         while time.monotonic() < deadline:
             if self.process.poll() is not None:
                 tail = ""
                 try:
-                    tail = self.log_path.read_text(errors="replace")[-2000:]
+                    tail = self.host.read_text(self.log_path)[-2000:]
                 except OSError:
                     pass
                 raise RuntimeError(
                     f"node {self.name} exited with {self.process.returncode}:"
                     f"\n{tail}")
             try:
-                text = self.log_path.read_text(errors="replace")
+                text = self.host.read_text(self.log_path)
             except OSError:
                 text = ""
             for line in text.splitlines():
@@ -201,41 +265,55 @@ def _node_env(device: str) -> dict:
     if device == "accelerator":
         env.pop("JAX_PLATFORMS", None)
         env.pop("XLA_FLAGS", None)
+        # Persistent compile cache: without it the device-owning notary
+        # pays the FULL Pallas/XLA compile on its first >=device_min_sigs
+        # batch — measured as a multi-minute in-measurement stall (r5: the
+        # raft-validating p99 hit 133 s while transactions queued behind
+        # the compile). bench.py warms the same cache dir, so a child that
+        # inherits it compiles once per machine, not once per process.
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       "/tmp/corda_tpu_jax_cache")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     else:
         env.setdefault("JAX_PLATFORMS", "cpu")
     return env
 
 
 class Driver:
-    def __init__(self, base_dir: Path):
+    def __init__(self, base_dir: Path, host: Host | None = None):
         self.base_dir = Path(base_dir)
         self.nodes: list[NodeProcess] = []
         self._deferred: list = []  # cleanup callbacks (run first in stop_all)
         self.netmap = self.base_dir / "netmap.json"
+        # Default placement for every node; start_node(host=...) overrides
+        # per node (the reference's loadtest places nodes on the remote
+        # hosts its config lists, ConnectionManager.kt).
+        self.host = host or LocalHost()
+
+    _NODE_ARGV = [sys.executable, "-m", "corda_tpu.node.node"]
+    _NODE_CWD = "/root/repo"
 
     def start_node(self, name: str, notary: str = "none",
                    cordapps: tuple[str, ...] = (), rpc: bool = False,
                    raft_cluster: tuple[str, ...] = (),
                    wait: bool = True, extra_toml: str = "",
-                   device: str = "cpu") -> NodeProcess:
+                   device: str = "cpu",
+                   host: Host | None = None) -> NodeProcess:
+        host = host or self.host
         node_dir = self.base_dir / name
-        node_dir.mkdir(parents=True, exist_ok=True)
+        host.mkdir(node_dir)
         rpc_users = [DEFAULT_RPC_USER] if rpc else []
         config_path = node_dir / "node.toml"
-        config_path.write_text(render_node_config(
+        host.write_file(config_path, render_node_config(
             name=name, node_dir=node_dir, netmap=self.netmap, notary=notary,
             raft_cluster=raft_cluster, cordapps=cordapps,
             extra_toml=extra_toml, rpc_users=rpc_users))
 
-        env = _node_env(device)
-        log = open(node_dir / "node.log", "ab")
-        process = subprocess.Popen(
-            [sys.executable, "-m", "corda_tpu.node.node", str(config_path)],
-            stdout=log, stderr=subprocess.STDOUT,
-            cwd="/root/repo", env=env)
-        log.close()  # the child owns the fd now
+        process = host.spawn(
+            self._NODE_ARGV + [str(config_path)],
+            node_dir / "node.log", self._NODE_CWD, _node_env(device))
         handle = NodeProcess(name, node_dir, config_path, process,
-                             rpc_users=rpc_users, device=device)
+                             rpc_users=rpc_users, device=device, host=host)
         self.nodes.append(handle)
         if wait:
             handle.wait_up()
@@ -245,17 +323,13 @@ class Driver:
                      wait: bool = True) -> NodeProcess:
         """Re-spawn a (killed) node over its existing base_dir + config —
         rebirth purely from disk (the kill/restart Disruption primitive)."""
-        env = _node_env(handle.device)
-        log = open(handle.base_dir / "node.log", "ab")
-        process = subprocess.Popen(
-            [sys.executable, "-m", "corda_tpu.node.node",
-             str(handle.config_path)],
-            stdout=log, stderr=subprocess.STDOUT,
-            cwd="/root/repo", env=env)
-        log.close()
+        process = handle.host.spawn(
+            self._NODE_ARGV + [str(handle.config_path)],
+            handle.base_dir / "node.log", self._NODE_CWD,
+            _node_env(handle.device))
         reborn = NodeProcess(handle.name, handle.base_dir, handle.config_path,
                              process, rpc_users=handle.rpc_users,
-                             device=handle.device)
+                             device=handle.device, host=handle.host)
         self.nodes.append(reborn)
         if wait:
             reborn.wait_up()
@@ -283,8 +357,8 @@ class Driver:
 
 
 @contextmanager
-def driver(base_dir: str | Path):
-    d = Driver(Path(base_dir))
+def driver(base_dir: str | Path, host: Host | None = None):
+    d = Driver(Path(base_dir), host=host)
     try:
         yield d
     finally:

@@ -98,3 +98,53 @@ def test_rendered_config_keeps_extra_toml_top_level(tmp_path):
     assert cfg.batch.max_sigs == 4096
     assert cfg.rpc_users and cfg.rpc_users[0]["username"] == "demo"
     assert "verifier" not in cfg.rpc_users[0]
+
+
+@pytest.mark.slow
+def test_host_seam_routes_every_placement_operation(tmp_path):
+    """The Host abstraction (reference: ConnectionManager.kt's remote-host
+    placement) carries EVERY file write, log read and spawn — the loadtest
+    harness runs unchanged through it, so an SSH host only has to
+    implement the same four methods."""
+    from corda_tpu.testing.driver import Driver, LocalHost
+
+    class CountingHost(LocalHost):
+        name = "counting-localhost"
+
+        def __init__(self):
+            self.calls = {"mkdir": 0, "write_file": 0, "read_text": 0,
+                          "spawn": 0}
+
+        def mkdir(self, path):
+            self.calls["mkdir"] += 1
+            return super().mkdir(path)
+
+        def write_file(self, path, text):
+            self.calls["write_file"] += 1
+            return super().write_file(path, text)
+
+        def read_text(self, path):
+            self.calls["read_text"] += 1
+            return super().read_text(path)
+
+        def spawn(self, argv, log_path, cwd, env):
+            self.calls["spawn"] += 1
+            return super().spawn(argv, log_path, cwd, env)
+
+    host = CountingHost()
+    d = Driver(tmp_path, host=host)
+    try:
+        node = d.start_node("Seam", rpc=True)
+        assert node.host is host
+        rpc = node.rpc("demo", "s3cret")
+        assert rpc.call("node_identity") is not None
+        rpc.close()
+        node.kill()
+        reborn = d.restart_node(node)
+        assert reborn.host is host and reborn.address is not None
+    finally:
+        d.stop_all()
+    assert host.calls["mkdir"] == 1
+    assert host.calls["write_file"] == 1
+    assert host.calls["spawn"] == 2      # start + restart
+    assert host.calls["read_text"] > 0   # banner polling reads the log

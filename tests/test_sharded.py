@@ -88,20 +88,23 @@ def test_mesh_verifier_provider_on_mesh():
 
     v = make_verifier("jax-sharded")
     assert isinstance(v, MeshVerifier) and v.name == "jax-sharded"
-    v = MeshVerifier(n_devices=8)
+    # device_min_sigs=0 pins the mesh route (the size crossover would
+    # send 21 jobs to the host tier and test nothing sharded).
+    v = MeshVerifier(n_devices=8, device_min_sigs=0)
     pks, msgs, sigs = _sig_fixture(21)
     jobs = [VerifyJob(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
     got = v.verify_batch(jobs)
     want = [ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
     assert got.tolist() == want
     assert v.mesh.devices.size == 8
+    assert (v.device_batches, v.host_batches) == (1, 0)
     assert v.verify_batch([]).tolist() == []
 
 
 def test_mesh_verifier_shadow_divergence_raises():
     from corda_tpu.crypto.provider import MeshVerifier, VerifyJob
 
-    v = MeshVerifier(n_devices=8, shadow_rate=1.0)
+    v = MeshVerifier(n_devices=8, shadow_rate=1.0, device_min_sigs=0)
     pks, msgs, sigs = _sig_fixture(5)
     jobs = [VerifyJob(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
     got = v.verify_batch(jobs)  # agreement: no raise
