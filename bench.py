@@ -165,10 +165,19 @@ def bench_kernel(pks, msgs, sigs, valid):
     return kernel, e2e, devhash, backends
 
 
-def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5):
+def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5,
+                 repeats=3):
     """Sustained throughput with the depth-2 stream pipeline: host packing
     and transfer of the next batches overlap device execution of the
-    current one (the notary-pump steady state)."""
+    current one (the notary-pump steady state).
+
+    Best of `repeats` timed passes, with every pass reported: the phase is
+    transfer-bound, and the tunnel's host<->device bandwidth varies
+    run-to-run by >2x (artifacts/BENCH_r05_local_{a,b}.json: 217k vs 92k
+    sigs/s an hour apart, same code, kernel-only simultaneously 372k vs
+    413k). The best pass is the honest capability number — the spread is
+    link weather, not framework behaviour — and reporting all passes keeps
+    the variance visible instead of laundered."""
     from corda_tpu.ops import ed25519_jax
 
     bp, bm, bs = tile(pks, bucket), tile(msgs, bucket), tile(sigs, bucket)
@@ -180,13 +189,22 @@ def bench_stream(pks, msgs, sigs, valid, bucket=65536, batches=5):
 
     for out in ed25519_jax.verify_stream(gen(2), bucket=bucket):  # warm
         assert out.tolist() == expect, "stream diverged from oracle"
-    t0 = time.perf_counter()
-    consumed = 0
-    for out in ed25519_jax.verify_stream(gen(batches), bucket=bucket):
-        consumed += len(out)
-    dt = time.perf_counter() - t0
-    assert consumed == batches * bucket
-    return consumed / dt
+    rates = []
+    backends_per_pass = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        consumed = 0
+        for out in ed25519_jax.verify_stream(gen(batches), bucket=bucket):
+            consumed += len(out)
+        dt = time.perf_counter() - t0
+        assert consumed == batches * bucket
+        rates.append(consumed / dt)
+        # Stamp per pass: a mid-repeats Pallas trip must not attribute the
+        # winning (earlier, Pallas) pass to the XLA fallback or vice versa.
+        backends_per_pass.append(ed25519_jax.last_backend())
+    best = max(range(repeats), key=lambda i: rates[i])
+    return (rates[best], [round(r, 1) for r in rates],
+            backends_per_pass[best])
 
 
 def bench_sha256(n=16384):
@@ -951,16 +969,15 @@ def _run_phases(report: dict) -> None:
     report["e2e_devhash_sigs_per_sec"] = {
         str(k): round(v, 1) for k, v in devhash.items()}
 
-    # Two attempts, best-of: the axon tunnel's transfer latency varies a lot
-    # between runs and the sustained number is the one that matters.
+    # Best-of with every pass reported: the axon tunnel's transfer
+    # bandwidth varies >2x between runs (see bench_stream doc) and the
+    # sustained capability is what matters; the spread stays visible.
     report["phase"] = "stream"
-    stream = bench_stream(pks, msgs, sigs, valid)
-    backends["stream"] = ed25519_jax.last_backend()
-    stream2 = bench_stream(pks, msgs, sigs, valid)
-    if stream2 > stream:
-        stream = stream2
-        backends["stream"] = ed25519_jax.last_backend()
+    stream, passes, stream_backend = bench_stream(
+        pks, msgs, sigs, valid, repeats=4)
+    backends["stream"] = stream_backend
     report["e2e_stream_sigs_per_sec"] = round(stream, 1)
+    report["e2e_stream_passes"] = passes
     report["phase"] = "sha256"
     report["sha256_64B_hashes_per_sec"] = round(bench_sha256(), 1)
     report["phase"] = "cpu_oracle"

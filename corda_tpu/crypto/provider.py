@@ -194,20 +194,14 @@ def _resolve_device_min_sigs(value: int | None) -> int:
         "CORDA_TPU_DEVICE_MIN_SIGS", DEVICE_MIN_SIGS_DEFAULT))
 
 
-class JaxVerifier(BatchVerifier):
-    """Batched JAX kernel with shadow-sampled oracle cross-checks.
-
-    shadow_rate: fraction of results re-verified on the CPU oracle; a mismatch
-    raises RuntimeError (divergence must never be silent).
-
-    Batches below device_min_sigs route to the HOST tier (same semantics:
-    CpuVerifier's accept-fast + oracle-authoritative path) — the per-batch
-    backend choice by size, mirroring hash_many_auto's crossover constant.
-    host_batches/device_batches count where work actually went so bench
-    stamps and node metrics can attribute every number.
-    """
-
-    name = "jax-batch"
+class DeviceRoutedVerifier(BatchVerifier):
+    """Shared routing policy for the device-backed verifiers: the size
+    crossover (batches under device_min_sigs take the host tier), the
+    boot-warm device_gate (batches host-route while a warm-up is in
+    flight — the first kernel call in a process pays backend init +
+    compile, measured stalling a notary ~100 s in-loop), and the
+    host/device batch counters every stamp reads. Subclasses implement
+    the device dispatch (_verify_ed25519_device) and warm()."""
 
     def __init__(self, shadow_rate: float = 0.0,
                  rng: random.Random | None = None,
@@ -217,12 +211,8 @@ class JaxVerifier(BatchVerifier):
         self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
         self.host_batches = 0
         self.device_batches = 0
-        # When a boot-time warm-up is in flight (node.py
-        # _warm_verifier_maybe sets this to its done-event), batches route
-        # to the host tier until it completes: the first kernel call in a
-        # process pays backend init + compile, and taking that hit inside
-        # the node run loop was measured stalling a notary ~100 s while
-        # closed-loop traffic queued. None (the default) means no gate.
+        # node.py _warm_verifier_maybe installs its done-event here;
+        # None (the default) means no gate.
         self.device_gate = None
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
@@ -239,29 +229,60 @@ class JaxVerifier(BatchVerifier):
             self.host_batches += 1
             return CpuVerifier._verify_ed25519_host(jobs)
         self.device_batches += 1
-        from ..ops import ed25519_jax
-
-        out = ed25519_jax.verify_batch(
-            [j.pubkey for j in jobs], [j.message for j in jobs], [j.sig for j in jobs]
-        )
+        out = self._verify_ed25519_device(jobs)
         _shadow_check(jobs, out, self.shadow_rate, self._rng)
         return out
 
+    def _verify_ed25519_device(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        raise NotImplementedError
+
     def warm(self) -> None:
-        """Compile THIS verifier's device path at both pump bucket sizes
-        (pick_bucket ladder: light rounds pad to 1024, backlogged rounds
-        reach max_sigs=4096), bypassing the gate/size routing. Called by
-        the node's boot warm-up thread; blocking and exception-raising —
-        the caller owns gating and error policy."""
+        """Compile THIS verifier's device path at both pump bucket sizes,
+        bypassing the gate/size routing. Blocking and exception-raising —
+        the caller (node.py boot warm-up) owns gating and error policy."""
+        raise NotImplementedError
+
+
+# Warm batch sizes covering the pump's REAL bucket ladder on every backend:
+# 513 -> bucket 1024 (the smallest batch the size crossover sends to the
+# device, with or without the Pallas >=1024 pad) and 1025 -> bucket 4096
+# (backlogged rounds reach max_sigs=4096). A 1-sig warm would compile
+# bucket 64 under plain XLA — a graph the pump never uses — leaving the
+# 1024 bucket cold exactly when Pallas is unavailable.
+WARM_SIZES = (513, 1025)
+
+
+class JaxVerifier(DeviceRoutedVerifier):
+    """Batched JAX kernel with shadow-sampled oracle cross-checks.
+
+    shadow_rate: fraction of results re-verified on the CPU oracle; a mismatch
+    raises RuntimeError (divergence must never be silent).
+
+    Batches below device_min_sigs route to the HOST tier (same semantics:
+    CpuVerifier's accept-fast + oracle-authoritative path) — the per-batch
+    backend choice by size, mirroring hash_many_auto's crossover constant.
+    host_batches/device_batches count where work actually went so bench
+    stamps and node metrics can attribute every number.
+    """
+
+    name = "jax-batch"
+
+    def _verify_ed25519_device(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         from ..ops import ed25519_jax
 
-        ed25519_jax.verify_batch([bytes(32)], [bytes(32)], [bytes(64)])
-        n = 1025  # > 1024 => the 4096 bucket's graphs
-        ed25519_jax.verify_batch([bytes(32)] * n, [bytes(32)] * n,
-                                 [bytes(64)] * n)
+        return ed25519_jax.verify_batch(
+            [j.pubkey for j in jobs], [j.message for j in jobs],
+            [j.sig for j in jobs])
+
+    def warm(self) -> None:
+        from ..ops import ed25519_jax
+
+        for n in WARM_SIZES:
+            ed25519_jax.verify_batch([bytes(32)] * n, [bytes(32)] * n,
+                                     [bytes(64)] * n)
 
 
-class MeshVerifier(BatchVerifier):
+class MeshVerifier(DeviceRoutedVerifier):
     """SPMD verify over a device mesh: the batch axis of every verify batch
     is sharded across the local devices with shard_map (ops/sharded.py), so
     a multi-chip slice verifies one notary batch cooperatively — the
@@ -281,14 +302,10 @@ class MeshVerifier(BatchVerifier):
                  shadow_rate: float = 0.0,
                  rng: random.Random | None = None,
                  device_min_sigs: int | None = None):
+        super().__init__(shadow_rate=shadow_rate, rng=rng,
+                         device_min_sigs=device_min_sigs)
         self.n_devices = n_devices
-        self.shadow_rate = shadow_rate
-        self._rng = rng or random.Random(0)
         self._mesh = None
-        self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
-        self.host_batches = 0
-        self.device_batches = 0
-        self.device_gate = None  # same boot-warm gate as JaxVerifier
 
     @property
     def mesh(self):
@@ -298,41 +315,22 @@ class MeshVerifier(BatchVerifier):
             self._mesh = sharded.make_mesh(self.n_devices)
         return self._mesh
 
-    def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        if not jobs:
-            return np.zeros(0, bool)
-        return _dispatch_mixed(jobs, self._verify_ed25519)
-
-    def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
-        if (len(jobs) < self.device_min_sigs
-                or (self.device_gate is not None
-                    and not self.device_gate.is_set())):
-            # Same size crossover as JaxVerifier: a mesh dispatch costs
-            # MORE per call than single-chip, so tiny batches stay host.
-            self.host_batches += 1
-            return CpuVerifier._verify_ed25519_host(jobs)
-        self.device_batches += 1
+    def _verify_ed25519_device(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         from ..ops import sharded
 
-        out = sharded.verify_batch_sharded(
+        return sharded.verify_batch_sharded(
             [j.pubkey for j in jobs], [j.message for j in jobs],
             [j.sig for j in jobs], self.mesh)
-        _shadow_check(jobs, out, self.shadow_rate, self._rng)
-        return out
 
     def warm(self) -> None:
         """Compile the SHARDED graphs this verifier actually dispatches
         (warming the single-chip kernel would open the gate without the
-        mesh path ever compiling). Same contract as JaxVerifier.warm."""
+        mesh path ever compiling)."""
         from ..ops import sharded
 
-        n_small = self.mesh.devices.size  # one lane per device, padded
-        sharded.verify_batch_sharded([bytes(32)] * n_small,
-                                     [bytes(32)] * n_small,
-                                     [bytes(64)] * n_small, self.mesh)
-        n = 1025
-        sharded.verify_batch_sharded([bytes(32)] * n, [bytes(32)] * n,
-                                     [bytes(64)] * n, self.mesh)
+        for n in WARM_SIZES:
+            sharded.verify_batch_sharded([bytes(32)] * n, [bytes(32)] * n,
+                                         [bytes(64)] * n, self.mesh)
 
 
 _default: BatchVerifier | None = None

@@ -24,6 +24,7 @@
 #include <Python.h>
 #include <pthread.h>
 #include <stddef.h>
+#include <stdint.h>
 #include <string.h>
 
 typedef struct evp_pkey_st EVP_PKEY;
@@ -238,10 +239,138 @@ done:
     return out;
 }
 
+/* pack_words(pubkeys, msgs, sigs, bucket) -> (a, r, s, m) bytes objects.
+ *
+ * Host packing for the device-hash verify path: each output is the raw
+ * memory of an (8, bucket) uint32 word-major array — out[w*B + i] is the
+ * little-endian 32-bit word at encoding[i][4w..4w+3]; lanes beyond n are
+ * zero. This replaces the Python/numpy packer (ed25519_jax.py
+ * precompute_batch_device: per-item bytes() + b"".join + frombuffer +
+ * transpose-copy), which was the measured bottleneck of the streaming
+ * pipeline (host pack rate < kernel rate, so the depth-2 overlap starved
+ * the device). Semantics match the Python path exactly: every pk and msg
+ * must be 32 bytes and every sig 64, else ValueError.
+ *
+ * The fill loops run with the GIL RELEASED (buffers captured first), so a
+ * node's transport threads keep moving while a 64k-lane batch packs.
+ */
+static int fill_words(uint32_t *dst, Py_ssize_t B, Py_ssize_t n,
+                      const unsigned char **src, Py_ssize_t off,
+                      Py_ssize_t nwords) {
+    for (Py_ssize_t i = 0; i < n; i++) {
+        const unsigned char *e = src[i] + off;
+        for (Py_ssize_t w = 0; w < nwords; w++) {
+            dst[w * B + i] = (uint32_t)e[4 * w]
+                             | ((uint32_t)e[4 * w + 1] << 8)
+                             | ((uint32_t)e[4 * w + 2] << 16)
+                             | ((uint32_t)e[4 * w + 3] << 24);
+        }
+    }
+    return 0;
+}
+
+static PyObject *pack_words(PyObject *self, PyObject *args) {
+    PyObject *pks, *msgs, *sigs;
+    Py_ssize_t bucket;
+    if (!PyArg_ParseTuple(args, "OOOn", &pks, &msgs, &sigs, &bucket))
+        return NULL;
+    PyObject *seqs[3] = {NULL, NULL, NULL};
+    PyObject *result = NULL;
+    Py_buffer *views = NULL;
+    const unsigned char **ptrs = NULL;
+    Py_ssize_t n_views = 0;
+    PyObject *outs[4] = {NULL, NULL, NULL, NULL};
+
+    seqs[0] = PySequence_Fast(pks, "pubkeys must be a sequence");
+    seqs[1] = PySequence_Fast(msgs, "msgs must be a sequence");
+    seqs[2] = PySequence_Fast(sigs, "sigs must be a sequence");
+    if (seqs[0] == NULL || seqs[1] == NULL || seqs[2] == NULL)
+        goto done;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seqs[0]);
+    if (PySequence_Fast_GET_SIZE(seqs[1]) != n
+        || PySequence_Fast_GET_SIZE(seqs[2]) != n) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pubkeys, msgs and sigs must have equal length");
+        goto done;
+    }
+    if (bucket < n) {
+        PyErr_SetString(PyExc_ValueError, "bucket smaller than batch");
+        goto done;
+    }
+    if (n > 0) {
+        views = PyMem_Calloc((size_t)n * 3, sizeof(Py_buffer));
+        ptrs = PyMem_Calloc((size_t)n * 3, sizeof(unsigned char *));
+        if (views == NULL || ptrs == NULL) {
+            PyErr_NoMemory();
+            goto done;
+        }
+    }
+    static const Py_ssize_t want_len[3] = {32, 32, 64};
+    static const char *len_err[3] = {
+        "pubkeys must be 32 bytes",
+        "device-hash path requires 32-byte messages",
+        "sigs must be 64 bytes",
+    };
+    for (Py_ssize_t i = 0; i < n; i++) {
+        for (int k = 0; k < 3; k++) {
+            PyObject *item = PySequence_Fast_GET_ITEM(seqs[k], i);
+            if (PyObject_GetBuffer(item, &views[n_views],
+                                   PyBUF_SIMPLE) != 0)
+                goto done; /* propagate (TypeError), matching bytes(m) */
+            n_views++;
+            if (views[n_views - 1].len != want_len[k]) {
+                PyErr_SetString(PyExc_ValueError, len_err[k]);
+                goto done;
+            }
+            ptrs[k * n + i] = views[n_views - 1].buf;
+        }
+    }
+    /* 4 outputs: A (pk), R (sig[:32]), S (sig[32:]), M (msg) — each
+     * 8 words x bucket lanes, zero-padded beyond n. */
+    for (int k = 0; k < 4; k++) {
+        outs[k] = PyBytes_FromStringAndSize(NULL, 8 * bucket * 4);
+        if (outs[k] == NULL)
+            goto done;
+        memset(PyBytes_AS_STRING(outs[k]), 0, (size_t)(8 * bucket * 4));
+    }
+    {
+        uint32_t *a_w = (uint32_t *)PyBytes_AS_STRING(outs[0]);
+        uint32_t *r_w = (uint32_t *)PyBytes_AS_STRING(outs[1]);
+        uint32_t *s_w = (uint32_t *)PyBytes_AS_STRING(outs[2]);
+        uint32_t *m_w = (uint32_t *)PyBytes_AS_STRING(outs[3]);
+        const unsigned char **pk_p = ptrs;
+        const unsigned char **msg_p = ptrs + n;
+        const unsigned char **sig_p = ptrs + 2 * n;
+        Py_BEGIN_ALLOW_THREADS
+        fill_words(a_w, bucket, n, pk_p, 0, 8);
+        fill_words(r_w, bucket, n, sig_p, 0, 8);
+        fill_words(s_w, bucket, n, sig_p, 32, 8);
+        fill_words(m_w, bucket, n, msg_p, 0, 8);
+        Py_END_ALLOW_THREADS
+    }
+    result = PyTuple_Pack(4, outs[0], outs[1], outs[2], outs[3]);
+
+done:
+    for (Py_ssize_t k = 0; k < n_views; k++)
+        PyBuffer_Release(&views[k]);
+    PyMem_Free(views);
+    PyMem_Free(ptrs);
+    for (int k = 0; k < 4; k++)
+        Py_XDECREF(outs[k]);
+    Py_XDECREF(seqs[0]);
+    Py_XDECREF(seqs[1]);
+    Py_XDECREF(seqs[2]);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"verify_many", verify_many, METH_VARARGS,
      "Batch Ed25519 verify via libcrypto, GIL released; returns one 0/1 "
      "byte per job. Accept-fast only: rejects need an oracle re-check."},
+    {"pack_words", pack_words, METH_VARARGS,
+     "pack_words(pks, msgs, sigs, bucket) -> (a, r, s, m) raw (8, bucket) "
+     "uint32 word arrays for the device-hash verify path; GIL released "
+     "during the fill."},
     {NULL, NULL, 0, NULL},
 };
 

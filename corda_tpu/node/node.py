@@ -511,9 +511,18 @@ class Node:
             # An in-process (test/embedded) node must not carry a live
             # compile thread into interpreter exit — XLA C++ aborts when a
             # cancelled pthread unwinds through it. CPU warms finish in
-            # seconds; production nodes exit by process death, where the
-            # daemon thread dies cleanly with the process.
+            # seconds, well inside the bound; a REAL-device warm can run
+            # minutes (and a wedged tunnel, indefinitely), so the join
+            # stays bounded — stop() must never hang — and a timeout is
+            # reported loudly: the embedder should prefer process exit
+            # (os._exit / child-process nodes, the production topology)
+            # over interpreter finalization while the device is warming.
             self._warm_thread.join(timeout=30.0)
+            if self._warm_thread.is_alive():
+                logging.getLogger("corda_tpu.node").warning(
+                    "verifier warm-up still compiling after stop(); "
+                    "interpreter exit may abort — exit this process via "
+                    "process death, not finalization")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -29,7 +29,8 @@ def _stub_phases(monkeypatch):
         lambda *a: ({4096: 1000.0}, {4096: 800.0}, {4096: 900.0},
                     {"kernel": {4096: "pallas"}, "e2e": {4096: "pallas"},
                      "e2e_devhash": {4096: "pallas"}}))
-    monkeypatch.setattr(bench, "bench_stream", lambda *a, **k: 1200.0)
+    monkeypatch.setattr(bench, "bench_stream",
+                        lambda *a, **k: (1200.0, [1100.0, 1200.0], "pallas"))
     monkeypatch.setattr(bench, "bench_sha256", lambda: 5000.0)
     monkeypatch.setattr(bench, "bench_cpu_oracle", lambda *a: 250.0)
 

@@ -341,3 +341,50 @@ def test_pallas_disabled_after_consecutive_failures(monkeypatch):
         assert kernel._PALLAS_STATE["failures_total"] == calls["pallas"]
     finally:
         kernel.reset_pallas_state()
+
+
+def test_native_pack_parity():
+    """The native packer (_cverify.c pack_words) must produce byte-for-byte
+    the same word arrays as the numpy path, and reject the same inputs —
+    the same authority/fast-path contract as the codec core."""
+    import numpy as np
+    import pytest
+
+    from corda_tpu.crypto import ref_ed25519 as ref
+    from corda_tpu.ops import ed25519_jax
+
+    native = ed25519_jax._cpack_module()
+    if native is None:
+        pytest.skip("no native toolchain/libcrypto")
+
+    pks, msgs, sigs = [], [], []
+    for i in range(37):  # odd size: padding lanes exercised
+        seed = bytes([(i % 255) + 1]) * 32
+        pks.append(ref.public_key(seed))
+        m = (b"pack-%d" % i).ljust(32, b".")
+        msgs.append(m)
+        sigs.append(ref.sign(seed, m))
+    bucket = 64
+
+    raw = native.pack_words(pks, msgs, sigs, bucket)
+    got = [np.frombuffer(r, "<u4").reshape(8, bucket) for r in raw]
+
+    m_cat = b"".join(msgs)
+    _, _, pk, r_enc, s_raw = ed25519_jax._pack_pk_rs(pks, sigs, 37, bucket)
+    m_raw = np.zeros((bucket, 32), np.uint8)
+    m_raw[:37] = np.frombuffer(m_cat, np.uint8).reshape(37, 32)
+    want = [ed25519_jax._words_of(x) for x in (pk, r_enc, s_raw, m_raw)]
+    for g, w, name in zip(got, want, "ARSM"):
+        assert np.array_equal(g, w), f"{name} words diverged"
+
+    # Rejection parity: ValueError on a short message / short key / bad sig
+    with pytest.raises(ValueError):
+        native.pack_words(pks, [b"short"] + msgs[1:], sigs, bucket)
+    with pytest.raises(ValueError):
+        native.pack_words([b"\x00" * 31] + pks[1:], msgs, sigs, bucket)
+    with pytest.raises(ValueError):
+        native.pack_words(pks, msgs, [b"\x00" * 63] + sigs[1:], bucket)
+    with pytest.raises(ValueError):
+        native.pack_words(pks[:-1], msgs, sigs, bucket)  # length mismatch
+    with pytest.raises(ValueError):
+        native.pack_words(pks, msgs, sigs, 16)  # bucket < n
