@@ -61,16 +61,20 @@ def test_watchdog_timeout_still_prints_partial_report(monkeypatch, capsys):
     def wedge(*a):
         raise bench.BenchTimeout("bench watchdog fired after 1s")
 
-    monkeypatch.setattr(bench, "bench_kernel", wedge)  # wedge mid-run
+    # Wedge in the TAIL configs: the headline phases run first now, so a
+    # watchdog fire during the slow multiprocess stretch must cost only
+    # the remaining configs — never the north-star number.
+    monkeypatch.setattr(bench, "bench_flow_churn", wedge)
     bench.main()
     report = json.loads(capsys.readouterr().out.strip())
     # Everything that finished is present; the wedge is attributed.
     assert report["error"] == "bench watchdog fired after 1s"
-    assert report["error_phase"] == "kernel_buckets"
+    assert report["error_phase"] == "flow_churn"
     assert report["notary_roundtrip"] == {"tx_per_sec": 100.0}
-    assert report["baseline_configs"]["flow_churn"] == {
-        "stub": "bench_flow_churn"}
-    assert report["value"] == 0.0  # headline never computed: honest zero
+    assert report["value"] == 1200.0  # headline already landed
+    assert report["baseline_configs"]["partial_merkle"] == {
+        "stub": "bench_partial_merkle"}
+    assert "flow_churn" not in report["baseline_configs"]
 
 
 def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
@@ -101,3 +105,27 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
     assert report["baseline_configs"]["resolve_ids"] == {
         "stub": "bench_resolve_ids"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
+
+
+def test_watchdog_during_headline_phase_reports_honest_zero(monkeypatch,
+                                                            capsys):
+    """A wedge BEFORE the headline lands (kernel phase) must print the
+    honest 0.0 with the wedge attributed — and the in-flight phase's wall
+    time must appear in phase_seconds (the attribution the clock exists
+    for)."""
+    _stub_phases(monkeypatch)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
+
+    def wedge(*a):
+        raise bench.BenchTimeout("bench watchdog fired after 1s")
+
+    monkeypatch.setattr(bench, "bench_kernel", wedge)
+    bench.main()
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["error"] == "bench watchdog fired after 1s"
+    assert report["error_phase"] == "kernel_buckets"
+    assert report["value"] == 0.0  # headline never computed: honest zero
+    assert report["notary_roundtrip"] == {"tx_per_sec": 100.0}
+    assert "baseline_configs" not in report
+    assert "kernel_buckets" in report["phase_seconds"]
+    assert "_phase_started" not in report
