@@ -748,6 +748,10 @@ def _install_watchdog(seconds: int, report: dict):
         prior = snap.get("error")
         snap["error"] = f"{prior}; {hard}" if prior else hard
         snap["error_phase"] = snap.get("phase")
+        # The wedged phase's wall time is the whole point of the phase
+        # clock in this scenario — flush it, and keep the internal marker
+        # out of the driver-contract JSON.
+        _flush_inflight_phase(snap)
         snap.pop("phase", None)
         _print_report_once(snap)
         os._exit(1)
@@ -916,19 +920,19 @@ def _run_host_only_phases(report: dict,
     pks, msgs, sigs, _ = make_corpus()
     report["cpu_oracle_sigs_per_sec"] = round(
         bench_cpu_oracle(pks, msgs, sigs), 1)
+    set_phase("done")
 
 
 def _run_phases(report: dict) -> None:
     import jax
 
     # Persistent compilation cache: the kernel zoo (per-bucket Ed25519 +
-    # SHA-512 graphs) compiles once per machine instead of once per run.
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/corda_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax: cache knobs absent; just compile
+    # SHA-512 graphs) compiles once per machine instead of once per run —
+    # the shared helper also makes lowering location-free so cache keys
+    # survive source edits (see corda_tpu/ops/__init__.py).
+    from corda_tpu.ops import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
 
     # Device init runs in a worker thread with a join timeout — the ONE
     # liveness gate: the observed tunnel wedge blocks uninterruptibly (and

@@ -46,5 +46,24 @@ def enable_persistent_compile_cache() -> None:
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        want_locations = _os.environ.get(
+            "CORDA_TPU_FULL_TRACEBACK_LOCATIONS", "")
+        if want_locations.strip().lower() not in ("", "0", "false", "no"):
+            jax.config.update("jax_include_full_tracebacks_in_locations",
+                              True)
+        else:
+            # Caller tracebacks embed in the lowered module's debug
+            # locations, and for Pallas kernels those locations reach the
+            # serialized Mosaic payload — so the CACHE KEY depended on the
+            # call site's line numbers (measured: 37 distinct keys for one
+            # identical kernel; every source edit or new call site forced
+            # a full ~25 s recompile per process, and the cache never hit
+            # across differently-shaped callers). Location-free lowering
+            # makes the key a function of the kernel alone. Trade-off:
+            # XLA error messages lose caller frames — set
+            # CORDA_TPU_FULL_TRACEBACK_LOCATIONS=1 when debugging a
+            # lowering failure.
+            jax.config.update("jax_include_full_tracebacks_in_locations",
+                              False)
     except Exception:
         pass  # older jax without the knobs: just compile in-process

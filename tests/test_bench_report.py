@@ -129,3 +129,32 @@ def test_watchdog_during_headline_phase_reports_honest_zero(monkeypatch,
     assert "baseline_configs" not in report
     assert "kernel_buckets" in report["phase_seconds"]
     assert "_phase_started" not in report
+
+
+def test_hard_backstop_snapshot_flushes_inflight_phase(monkeypatch):
+    """The hard-watchdog snapshot path must attribute the wedged phase's
+    wall time and keep the internal _phase_started marker out of the
+    driver-contract JSON (the graceful path already does both)."""
+    report = {"metric": "verified_sigs_per_sec", "value": 0.0,
+              "phase": "kernel_buckets", "_phase_started": 0.0,
+              "phase_seconds": {"warm": 2.0}}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: 5.0)
+    snap = dict(report)
+    bench._flush_inflight_phase(snap)
+    snap.pop("phase", None)
+    assert snap["phase_seconds"]["kernel_buckets"] == 5.0
+    assert "_phase_started" not in snap
+    # And the graceful main() path strips the marker on success too.
+    assert "_phase_started" not in json.loads(_healthy_report_json())
+
+
+def _healthy_report_json():
+    import io
+    from contextlib import redirect_stdout
+
+    bench._printed = False  # earlier tests' main() already printed
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench._print_report_once({"metric": "verified_sigs_per_sec",
+                                  "value": 1.0})
+    return buf.getvalue().strip()
