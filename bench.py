@@ -317,6 +317,11 @@ def _verifier_stamp(verifier) -> dict:
         stamp["device_batches"] = verifier.device_batches
         stamp["host_batches"] = verifier.host_batches
         stamp["device_min_sigs"] = verifier.device_min_sigs
+        if verifier.device_batches == 0 and verifier.host_batches > 0:
+            # The kernel backend did not produce THIS config's numbers —
+            # every batch took the host tier (last_backend would report
+            # whatever the warm-up compiled, a misattribution).
+            stamp["backend"] = "host-routed"
     return stamp
 
 

@@ -23,12 +23,12 @@ def net():
     network.stop_nodes()
 
 
-def _issue_and_finalise(net, node, notary_party, magic=3):
+def _issue_and_finalise(net, node, notary_party, magic=3, recipients=()):
     builder = DummyContract.generate_initial(
         node.identity.ref(b"\x00"), magic, notary_party)
     builder.sign_with(node.key)
     stx = builder.to_signed_transaction()
-    handle = node.start_flow(FinalityFlow(stx, ()))
+    handle = node.start_flow(FinalityFlow(stx, tuple(recipients)))
     net.run_network()
     handle.result.result()
     return stx, handle
@@ -143,3 +143,28 @@ def test_mapping_over_rpc_poll_and_push(tmp_path):
         stop.set()
         pumper.join(timeout=2)
         node.stop()
+
+
+def test_responder_side_records_provenance_too(net):
+    """A two-party broadcast: the RECIPIENT's responder flow (data-vending
+    NotifyTransactionHandler) records the tx with ITS OWN run id — both
+    ledgers can attribute the tx to the protocol run that delivered it
+    (reference: every recordTransactions call site feeds the mapping,
+    ServiceHubInternal)."""
+    notary = net.create_notary_node("Notary")
+    alice = net.create_node("Alice")
+    bob = net.create_node("Bob")
+    stx, handle = _issue_and_finalise(net, alice, notary.identity, magic=9,
+                                      recipients=(bob.identity,))
+
+    for node, run_id in ((alice, handle.run_id), (bob, None)):
+        mapping = node.services.storage_service \
+            .state_machine_recorded_transaction_mapping
+        entries = [m for m in mapping.mappings() if m.tx_id == stx.id]
+        assert entries, f"{node.identity.name} has no mapping for the tx"
+        if run_id is not None:
+            assert entries[0].run_id == run_id
+        else:
+            # Bob's mapping belongs to his responder flow — a run id of
+            # HIS state machine, not Alice's.
+            assert entries[0].run_id != handle.run_id
