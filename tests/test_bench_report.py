@@ -158,3 +158,56 @@ def _healthy_report_json():
         bench._print_report_once({"metric": "verified_sigs_per_sec",
                                   "value": 1.0})
     return buf.getvalue().strip()
+
+
+def test_device_fault_mid_kernel_still_reports(monkeypatch, capsys):
+    """A tunnel fault (generic exception, not BenchTimeout) inside a device
+    phase must not lose the run: the phase records its error, later phases
+    still measure, and exactly one JSON line prints."""
+    _stub_phases(monkeypatch)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
+
+    def fault(*a):
+        raise RuntimeError("TPU device error - infrastructure failure")
+
+    monkeypatch.setattr(bench, "bench_kernel", fault)
+    bench.main()
+    report = json.loads(capsys.readouterr().out.strip())
+    assert "TPU device error" in report["kernel_error"]
+    assert report["value"] == 1200.0  # stream still delivered the headline
+    assert report["baseline_configs"]["flow_churn"] == {
+        "stub": "bench_flow_churn"}
+    assert report.get("error") is None  # isolated fault, run completed
+
+
+def test_warm_fault_degrades_to_host_only(monkeypatch, capsys):
+    """A device fault during warm-up means NO device phase can be trusted:
+    the run degrades to the host-only sweep instead of failing slowly."""
+    _stub_phases(monkeypatch)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
+    monkeypatch.setattr(bench, "warm_buckets", lambda *a, **k: (_ for _ in ())
+                        .throw(RuntimeError("UNAVAILABLE: TPU device error")))
+    bench.main()
+    report = json.loads(capsys.readouterr().out.strip())
+    assert "faulted during warm-up" in report["error"]
+    assert "UNAVAILABLE" in report["device_error"]
+    assert report["baseline_configs"]["flow_churn"] == {
+        "stub": "bench_flow_churn"}
+    assert report["value"] == 0.0  # no device headline: honest zero
+
+
+def test_total_crash_still_prints_one_line(monkeypatch, capsys):
+    """Even an exception no phase handler catches produces the one-line
+    report with the crash attributed (the driver records stdout; a bare
+    traceback would lose the whole run)."""
+    _stub_phases(monkeypatch)
+    monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
+    monkeypatch.setattr(bench, "make_corpus",
+                        lambda *a: (_ for _ in ()).throw(
+                            RuntimeError("totally unexpected")))
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    report = json.loads(out[0])
+    assert "crash in" in report["error"]
+    assert "totally unexpected" in report["error"]
