@@ -1,0 +1,292 @@
+"""Asynchronous pipelined verification: the device feeder thread.
+
+The run loop is single-threaded by design (the cooperative-pump race
+discipline: socket/worker threads enqueue only, flow logic runs on the
+loop). Verification used to run synchronously inside the per-round
+db.batch() transaction, so Raft heartbeats, inbound messages and
+checkpoints all stalled behind the verifier — and because every round
+flushed its own accumulation, real flagship traffic almost never reached
+device_min_sigs and the device sat idle (round-5 VERDICT: kernel 292k
+sigs/s, end-to-end 3.9k with device_batches=0).
+
+This module decouples the two with ONE owned crossing:
+
+  run loop  --submit(jobs, context)-->  feeder thread  (owns the device)
+  run loop  <--drain()---------------  completion queue (the only way back)
+
+The run loop SUBMITS an accumulated batch and immediately continues; the
+feeder thread calls ``verifier.verify_batch`` (the GIL is released inside
+the native host tier and XLA dispatch, so the loop genuinely overlaps);
+finished handles post to a thread-safe completion queue the NEXT round
+drains to resume the parked flows. Flow state is never touched off-loop:
+the feeder sees only VerifyJob tuples and writes only to its own handle.
+
+Bounded in-flight depth (default 2 = double buffering: one batch on the
+device, one filling) lets batches accumulate ACROSS rounds without the
+backlog growing unboundedly, which is exactly what pushes real traffic
+over the device crossover.
+
+Crash contract: a submitted batch lives only in memory. The waiting flows
+were parked WITHOUT recording a verify outcome, so a crash replays them
+from their last durable checkpoint and they re-yield the verify — the
+existing at-least-once replay path — meaning lost in-flight results cost
+a re-verify, never a wrong answer.
+
+AdaptiveCrossover replaces blind trust in the static device_min_sigs env
+knob: it measures observed host-tier vs device-tier sigs/s from completed
+handles and walks the verifier's effective crossover toward whichever
+tier is actually faster on this host/backend (bounded both ways).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Sequence
+
+from .provider import BatchVerifier, VerifyJob
+
+
+class VerifyBatchHandle:
+    """One submitted batch crossing the thread boundary. The submitting
+    (run-loop) thread owns ``jobs``/``context``; the feeder thread fills
+    ``ok``/``error``/timing and never touches the handle again after
+    posting it to the completion queue."""
+
+    __slots__ = ("jobs", "context", "submitted_at", "started_at",
+                 "finished_at", "ok", "error", "tier")
+
+    def __init__(self, jobs: Sequence[VerifyJob], context: Any):
+        self.jobs = jobs
+        self.context = context
+        self.submitted_at = time.perf_counter()
+        self.started_at = 0.0
+        self.finished_at = 0.0
+        self.ok = None  # bool[N] on success
+        self.error: BaseException | None = None
+        self.tier = "host"  # "device" when the verifier dispatched the kernel
+
+    @property
+    def queue_wait_s(self) -> float:
+        """Time the batch sat behind earlier in-flight work."""
+        return max(0.0, self.started_at - self.submitted_at)
+
+    @property
+    def verify_wall_s(self) -> float:
+        """Wall time inside verify_batch on the feeder thread."""
+        return max(0.0, self.finished_at - self.started_at)
+
+
+class AdaptiveCrossover:
+    """Tunes the verifier's effective device_min_sigs from OBSERVED rates.
+
+    EWMA sigs/s per tier, fed at batch completion. With evidence on both
+    tiers: a device measurably faster than the host lowers the crossover
+    (feed it smaller batches); a device slower than the host raises it
+    (stop paying the dispatch tax). Hysteresis bands (x1.25 up / x0.8
+    down) and multiplicative steps keep it from oscillating; hard floor
+    and ceiling keep a pathological sample from pinning routing."""
+
+    ALPHA = 0.3  # EWMA weight for the newest observation
+    MIN_SAMPLE_SIGS = 32  # tiny batches measure overhead, not throughput
+    FLOOR = 64
+
+    def __init__(self, verifier: BatchVerifier):
+        self.verifier = verifier
+        static = getattr(verifier, "device_min_sigs", None)
+        self.enabled = static is not None
+        self.static_min_sigs = static if static else 0
+        self.ceiling = max(8 * (static or 0), 8192)
+        self.host_rate = 0.0
+        self.device_rate = 0.0
+        self.adjustments = 0
+
+    def observe(self, handle: VerifyBatchHandle) -> None:
+        if not self.enabled or handle.error is not None:
+            return
+        n = len(handle.jobs)
+        wall = handle.verify_wall_s
+        if n < self.MIN_SAMPLE_SIGS or wall <= 0.0:
+            return
+        rate = n / wall
+        if handle.tier == "device":
+            self.device_rate = (rate if not self.device_rate else
+                                self.ALPHA * rate
+                                + (1 - self.ALPHA) * self.device_rate)
+        else:
+            self.host_rate = (rate if not self.host_rate else
+                              self.ALPHA * rate
+                              + (1 - self.ALPHA) * self.host_rate)
+        self._retune()
+
+    def _retune(self) -> None:
+        if not (self.host_rate and self.device_rate):
+            return  # no evidence on one tier yet: keep the static policy
+        current = self.verifier.device_min_sigs
+        if self.device_rate > 1.25 * self.host_rate:
+            target = max(self.FLOOR, int(current * 0.75))
+        elif self.device_rate < 0.8 * self.host_rate:
+            target = min(self.ceiling, int(current * 1.5))
+        else:
+            return
+        if target != current:
+            self.verifier.device_min_sigs = target
+            self.adjustments += 1
+
+    @property
+    def effective_min_sigs(self) -> int | None:
+        return (self.verifier.device_min_sigs if self.enabled else None)
+
+
+_SENTINEL = object()
+
+
+class AsyncVerifyService:
+    """The feeder-thread pipeline between the run loop and the verifier.
+
+    Threading model (the ONLY sanctioned crossings):
+      * submit(): run loop -> submit queue. Increments the run-loop-owned
+        in-flight counter (no lock needed: only the loop reads/writes it).
+      * feeder thread: pops, calls verify_batch, posts the finished handle
+        to the completion queue. It never touches flow or node state.
+      * drain(): run loop pops completed handles non-blocking, decrements
+        in-flight, feeds the adaptive crossover, returns the handles for
+        delivery on the loop.
+
+    The feeder thread starts lazily on first submit (a sync-mode or idle
+    node never carries a thread) and is a daemon joined with a bounded
+    timeout at close() — a live thread inside XLA C++ at interpreter
+    finalization aborts, the same hazard the boot warm thread documents.
+    """
+
+    def __init__(self, verifier: BatchVerifier, depth: int = 2,
+                 adaptive: bool = True):
+        if depth < 1:
+            raise ValueError(f"async verify depth must be >= 1, got {depth}")
+        self.verifier = verifier
+        self.depth = depth
+        self.adaptive = AdaptiveCrossover(verifier) if adaptive else None
+        self._submit_q: queue.SimpleQueue = queue.SimpleQueue()
+        self._done_q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        # Run-loop-owned (single-threaded) pipeline accounting:
+        self.in_flight = 0
+        self.submitted_batches = 0
+        self.submitted_sigs = 0
+        self.completed_batches = 0
+        self.completed_sigs = 0
+        self.failed_batches = 0
+        self.queue_wait_s = 0.0
+        self.verify_wall_s = 0.0
+
+    # -- run-loop side -----------------------------------------------------
+
+    def can_submit(self) -> bool:
+        """Is there pipeline room? False = keep accumulating this round."""
+        return not self._closed and self.in_flight < self.depth
+
+    def target_sigs(self, max_sigs: int) -> int:
+        """The submit threshold for the accumulate-across-rounds gate: a
+        READY device verifier wants batches at the (possibly adaptively
+        tuned) crossover so submitted work actually engages the kernel;
+        everything else keeps the classic max_sigs policy. The max-wait
+        deadline still bounds accumulation either way."""
+        min_sigs = getattr(self.verifier, "device_min_sigs", None)
+        if min_sigs is None:
+            return max_sigs
+        gate = getattr(self.verifier, "device_gate", None)
+        if gate is not None and not gate.is_set():
+            return max_sigs  # cold device: batches host-route anyway
+        return max(1, min(max_sigs, min_sigs))
+
+    def submit(self, jobs: Sequence[VerifyJob], context: Any) -> VerifyBatchHandle:
+        if self._closed:
+            raise RuntimeError("AsyncVerifyService is closed")
+        handle = VerifyBatchHandle(jobs, context)
+        self.in_flight += 1
+        self.submitted_batches += 1
+        self.submitted_sigs += len(jobs)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._feeder, daemon=True, name="verify-feeder")
+            self._thread.start()
+        self._submit_q.put(handle)
+        return handle
+
+    def drain(self) -> list[VerifyBatchHandle]:
+        """Pop every completed handle (non-blocking); caller delivers."""
+        done: list[VerifyBatchHandle] = []
+        while True:
+            try:
+                handle = self._done_q.get_nowait()
+            except queue.Empty:
+                break
+            self.in_flight -= 1
+            self.completed_batches += 1
+            self.completed_sigs += len(handle.jobs)
+            self.queue_wait_s += handle.queue_wait_s
+            self.verify_wall_s += handle.verify_wall_s
+            if handle.error is not None:
+                self.failed_batches += 1
+            elif self.adaptive is not None:
+                self.adaptive.observe(handle)
+            done.append(handle)
+        return done
+
+    def stats(self) -> dict:
+        """Pipeline counters for node_metrics / loadtest stamps."""
+        out = {
+            "depth": self.depth,
+            "in_flight": self.in_flight,
+            "submitted_batches": self.submitted_batches,
+            "submitted_sigs": self.submitted_sigs,
+            "completed_batches": self.completed_batches,
+            "completed_sigs": self.completed_sigs,
+            "failed_batches": self.failed_batches,
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "verify_wall_s": round(self.verify_wall_s, 6),
+        }
+        if self.adaptive is not None and self.adaptive.enabled:
+            out["effective_min_sigs"] = self.adaptive.effective_min_sigs
+            out["static_min_sigs"] = self.adaptive.static_min_sigs
+            out["adaptive_adjustments"] = self.adaptive.adjustments
+            out["host_sigs_per_sec"] = round(self.adaptive.host_rate, 1)
+            out["device_sigs_per_sec"] = round(self.adaptive.device_rate, 1)
+        return out
+
+    def close(self, timeout: float = 30.0) -> bool:
+        """Stop accepting work and join the feeder (bounded — close must
+        never hang on a wedged device). Returns True when the thread is
+        down (or never started). In-flight results may be lost; the
+        at-least-once replay contract makes that safe."""
+        self._closed = True
+        thread = self._thread
+        if thread is None:
+            return True
+        self._submit_q.put(_SENTINEL)
+        thread.join(timeout=timeout)
+        return not thread.is_alive()
+
+    # -- feeder side -------------------------------------------------------
+
+    def _feeder(self) -> None:
+        while True:
+            item = self._submit_q.get()
+            if item is _SENTINEL:
+                return
+            item.started_at = time.perf_counter()
+            # Tier attribution by counter delta: this thread is the only
+            # verify_batch caller in async mode, so the delta is exact.
+            before = getattr(self.verifier, "device_batches", 0) or 0
+            try:
+                item.ok = self.verifier.verify_batch(item.jobs)
+            except BaseException as e:  # noqa: BLE001 — crossed to the loop
+                # The exception must cross back to the run loop and reject
+                # the waiting flows; swallowing it would hang them forever.
+                item.error = e
+            after = getattr(self.verifier, "device_batches", 0) or 0
+            item.tier = "device" if after > before else "host"
+            item.finished_at = time.perf_counter()
+            self._done_q.put(item)

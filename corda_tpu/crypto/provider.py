@@ -208,6 +208,12 @@ class DeviceRoutedVerifier(BatchVerifier):
                  device_min_sigs: int | None = None):
         self.shadow_rate = shadow_rate
         self._rng = rng or random.Random(0)
+        # Runtime-tunable: async_verify.AdaptiveCrossover rewrites this
+        # from observed host- vs device-tier sigs/s; the resolved value is
+        # only the starting point. Reads/writes stay single-threaded (the
+        # run loop owns routing policy; the feeder thread only reads it
+        # inside verify_batch — a stale read routes one batch, never
+        # corrupts state).
         self.device_min_sigs = _resolve_device_min_sigs(device_min_sigs)
         self.host_batches = 0
         self.device_batches = 0

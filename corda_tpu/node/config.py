@@ -14,7 +14,11 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: the API-identical backport
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -37,6 +41,14 @@ class BatchConfig:
     # an AppendEntries broadcast — a small accumulation window amortises
     # all three across the burst. 0 = wake-per-message (lowest latency).
     coalesce_ms: float = 0.0
+    # Async verify pipeline (crypto/async_verify.py): the run loop submits
+    # accumulated batches to a feeder thread and keeps serving Raft/
+    # messages/checkpoints while the verifier runs; False restores the
+    # in-round synchronous flush.
+    async_verify: bool = True
+    # Bounded in-flight submitted batches (2 = double buffering: one batch
+    # verifying, one filling).
+    async_depth: int = 2
 
 
 @dataclass(frozen=True)
@@ -110,6 +122,8 @@ class NodeConfig:
                 max_sigs=int(batch.get("max_sigs", 4096)),
                 max_wait_ms=float(batch.get("max_wait_ms", 2.0)),
                 coalesce_ms=float(batch.get("coalesce_ms", 0.0)),
+                async_verify=bool(batch.get("async_verify", True)),
+                async_depth=int(batch.get("async_depth", 2)),
             ),
             rpc_users=tuple(
                 dict(u) for u in raw.get("rpc_users", ())),

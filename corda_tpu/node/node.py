@@ -148,6 +148,14 @@ class Node:
             defer_verify=True,  # the run loop owns the flush policy
             defer_checkpoints=True,  # run_once flushes once per round
         )
+        if config.batch.async_verify:
+            # Pipelined verification: the run loop submits accumulated
+            # batches to a feeder thread and keeps serving Raft/messages/
+            # checkpoints while the verifier runs (crypto/async_verify.py).
+            from ..crypto.async_verify import AsyncVerifyService
+
+            self.smm.async_verify = AsyncVerifyService(
+                self.smm.verifier, depth=config.batch.async_depth)
         # Unknown send targets trigger an on-demand refresh (a client that
         # registered after our last periodic refresh must be reachable the
         # moment its first SessionInit arrives). Throttled: a send to a
@@ -325,8 +333,13 @@ class Node:
                 self.identity_service, self.key)
             self.netmap_client.register(self.info)
             self.netmap_client.fetch_and_subscribe()
-        self.smm.start()
+        # The warm gate must be on the verifier BEFORE checkpoint restore
+        # runs: smm.start() replays checkpointed flows, and a restored
+        # backlog can flush a >= device_min_sigs batch immediately — with
+        # no gate yet installed it would hit the cold device and stall the
+        # restart exactly like the pre-warm-up boot did.
         self._warm_verifier_maybe()
+        self.smm.start()
         self._started = True
         return self
 
@@ -394,15 +407,22 @@ class Node:
         bridges (messaging.flush_round) — one fsync per round instead of
         one per mutation, with the same at-least-once redelivery contract."""
         batch = self.config.batch
+        svc = self.smm.async_verify
         wait = timeout
         if self.smm.verify_pending_sigs:
             # Shrink the wait so the flush deadline is honoured.
             deadline = (self.smm.verify_waiting_since
                         + batch.max_wait_ms / 1e3)
             wait = max(0.0, min(timeout, deadline - time.monotonic()))
+        if svc is not None and svc.in_flight:
+            # A batch is on the feeder thread: come back quickly so its
+            # completion (and the flows it resumes) isn't left sitting a
+            # full idle timeout behind the device.
+            wait = min(wait, 0.002)
         stages = self.smm.metrics.setdefault(
             "round_stage_s", {"lock": 0.0, "pump": 0.0, "raft": 0.0,
                               "services": 0.0, "verify": 0.0,
+                              "verify_drain": 0.0, "verify_submit": 0.0,
                               "checkpoint": 0.0, "commit": 0.0, "rounds": 0})
         t = time.perf_counter
         t_pre = t()
@@ -418,6 +438,11 @@ class Node:
                 t2 = t()
                 self.smm.poll_services()
                 t3 = t()
+                # Drain completed async verifies BEFORE flush_appends so a
+                # raft commit submitted by a verify-resumed notary flow
+                # replicates in THIS round's AppendEntries.
+                self.smm.drain_async_verifies()
+                t3d = t()
                 if self.raft_member is not None:
                     # poll_services may have submitted commits; replicate
                     # them in THIS round (one coalesced AppendEntries per
@@ -426,11 +451,19 @@ class Node:
                 t4 = t()
                 self.scheduler.tick()
                 pending = self.smm.verify_pending_sigs
-                if pending and (
-                    pending >= batch.max_sigs
-                    or time.monotonic() - self.smm.verify_waiting_since
-                    >= batch.max_wait_ms / 1e3
-                ):
+                aged = pending and (
+                    time.monotonic() - self.smm.verify_waiting_since
+                    >= batch.max_wait_ms / 1e3)
+                if svc is not None:
+                    # Pipelined: submit and continue. The gate targets the
+                    # device crossover (accumulating ACROSS rounds) once
+                    # the kernel is warm; a full pipeline keeps
+                    # accumulating — bounded by depth, drained above.
+                    if pending and svc.can_submit() and (
+                            pending >= svc.target_sigs(batch.max_sigs)
+                            or aged):
+                        self.smm.submit_pending_verifies()
+                elif pending and (pending >= batch.max_sigs or aged):
                     self.smm.flush_pending_verifies()
                 t5 = t()
                 self.smm.flush_checkpoints()
@@ -440,13 +473,15 @@ class Node:
                     # durable outbox committed with it).
                     self.rpc.push_pending()
                 t6 = t()
-                # Stage accounting (cheap: 7 clock reads per round) is the
+                # Stage accounting (cheap: 8 clock reads per round) is the
                 # attribution artifact for the process-boundary throughput
                 # work — exported via node_metrics like every counter.
                 stages["pump"] += t1 - t0
-                stages["raft"] += (t2 - t1) + (t4 - t3)
+                stages["raft"] += (t2 - t1) + (t4 - t3d)
                 stages["services"] += t3 - t2
-                stages["verify"] += t5 - t4
+                stages["verify"] += (t3d - t3) + (t5 - t4)
+                stages["verify_drain"] += t3d - t3
+                stages["verify_submit"] += t5 - t4
                 stages["checkpoint"] += t6 - t5
                 stages["rounds"] += 1
         except BaseException:
@@ -505,6 +540,15 @@ class Node:
     def stop(self) -> None:
         if self.webserver is not None:
             self.webserver.stop()
+        svc = self.smm.async_verify
+        if svc is not None and not svc.close(timeout=30.0):
+            # Same interpreter-exit hazard as the warm thread below: a
+            # feeder blocked inside a wedged device call cannot be joined;
+            # report and prefer process death over finalization.
+            logging.getLogger("corda_tpu.node").warning(
+                "async verify feeder still running after stop(); "
+                "interpreter exit may abort — exit this process via "
+                "process death, not finalization")
         self.messaging.stop()
         self.db.close()
         if self._warm_thread is not None and self._warm_thread.is_alive():

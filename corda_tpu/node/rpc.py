@@ -185,6 +185,15 @@ class NodeRpcOps:
                 smm.verifier.device_gate.is_set()
                 if getattr(smm.verifier, "device_gate", None) is not None
                 else None),
+            # The size crossover currently in force (the adaptive tuner
+            # moves it at runtime); None for verifiers with no device tier.
+            "verify_device_min_sigs": getattr(
+                smm.verifier, "device_min_sigs", None),
+            # Async pipeline counters (crypto/async_verify.py): submitted/
+            # in-flight/completed batches, queue wait vs device wall, and
+            # the adaptive crossover state; None in synchronous mode.
+            "async_verify": (smm.async_verify.stats()
+                             if smm.async_verify is not None else None),
             # Per-flow-name completion timings (count/total_ms/max_ms) —
             # the per-flow half of the reference's JMX metrics export.
             "flow_timings": {k: dict(v)

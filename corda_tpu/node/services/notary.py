@@ -8,6 +8,15 @@ flow, wired to this node's TimestampChecker and UniquenessProvider.
 
 The service object is a checkpoint token (SerializeAsToken equivalent), so
 in-flight notarisation flows survive node restarts.
+
+Pipeline parallelism on the validating path: the service flow suspends at
+two pump seams — verify_signatures_batched (the verify micro-batch, served
+by the async feeder thread when batch.async_verify is on) and the Raft
+commit ServiceRequest (commit_async). The node round drains completed
+verifies BEFORE flushing AppendEntries, so tx N's replication overlaps
+tx N+1's device verify without this module doing anything special; keep
+new service-side work behind those same seams or it re-serialises the
+round (see ARCHITECTURE.md "Async verify pipeline").
 """
 
 from __future__ import annotations

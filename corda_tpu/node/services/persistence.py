@@ -37,6 +37,23 @@ from .api import (
 )
 
 
+def _sqlite_serialized() -> bool:
+    """Is the sqlite C library in serialized mode (safe to share one
+    connection across threads)? DB-API threadsafety 3 says yes directly;
+    Python < 3.11 hardcodes the module attribute at 1 regardless of how
+    the library was compiled, so fall back to asking the library itself
+    (SQLITE_THREADSAFE=1 is serialized mode)."""
+    if sqlite3.threadsafety == 3:
+        return True
+    conn = sqlite3.connect(":memory:")
+    try:
+        return conn.execute(
+            "SELECT 1 FROM pragma_compile_options"
+            " WHERE compile_options = 'THREADSAFE=1'").fetchone() is not None
+    finally:
+        conn.close()
+
+
 class NodeDatabase:
     """One sqlite file holding every durable table of a node.
 
@@ -91,10 +108,10 @@ class NodeDatabase:
     def __init__(self, path: str | os.PathLike):
         self.path = str(path)
         # Shared across the node thread and the transport's bridge threads:
-        # the sqlite C library serializes statement execution (threadsafety
-        # level 3 asserted below); `lock` additionally scopes multi-statement
+        # the sqlite C library serializes statement execution (serialized
+        # mode asserted below); `lock` additionally scopes multi-statement
         # transactions (e.g. the uniqueness commit) to one thread at a time.
-        assert sqlite3.threadsafety == 3, "need a serialized (threadsafe) sqlite"
+        assert _sqlite_serialized(), "need a serialized (threadsafe) sqlite"
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self.lock = threading.RLock()
         self._conn.execute("PRAGMA journal_mode=WAL")

@@ -740,6 +740,10 @@ class StateMachineManager:
         self._verify_sig_count = 0
         self._verify_waiting_since = 0.0
         self._service_queue: list[tuple[FlowStateMachine, Callable]] = []
+        # Async verify pipeline (crypto/async_verify.AsyncVerifyService),
+        # installed by the node assembly when batch.async_verify is on;
+        # None = the classic synchronous flush path.
+        self.async_verify = None
         self.recent_results: dict[bytes, FlowFuture] = {}
         self._pumping = False
         # Optional on-demand network-map refresh (set by the node assembly):
@@ -1001,9 +1005,20 @@ class StateMachineManager:
 
     def _flush_verify_batch(self) -> None:
         """One batched kernel call covering every parked VerifyTxRequest and
-        VerifySigRequest."""
+        VerifySigRequest (the synchronous path: verify on THIS thread)."""
         batch, self._verify_queue = self._verify_queue, []
         self._verify_sig_count = 0
+        jobs, spans = self._build_verify_jobs(batch)
+        ok = self.verifier.verify_batch(jobs) if jobs else []
+        self.metrics["verify_batches"] += 1
+        self.metrics["verify_sigs"] += len(jobs)
+        self._deliver_verify_results(spans, ok)
+
+    def _build_verify_jobs(
+        self, batch: "list[tuple[FlowStateMachine, Any]]",
+    ) -> "tuple[list[VerifyJob], list[tuple[FlowStateMachine, Any, int, int]]]":
+        """Flatten parked requests into one VerifyJob list plus per-request
+        spans mapping result ranges back to the waiting flows."""
         jobs: list[VerifyJob] = []
         spans: list[tuple[FlowStateMachine, Any, int, int]] = []
         for fsm, request in batch:
@@ -1022,10 +1037,16 @@ class StateMachineManager:
                     for sig in request.stx.sigs
                 )
             spans.append((fsm, request, start, len(jobs)))
-        ok = self.verifier.verify_batch(jobs) if jobs else []
-        self.metrics["verify_batches"] += 1
-        self.metrics["verify_sigs"] += len(jobs)
+        return jobs, spans
+
+    def _deliver_verify_results(self, spans, ok) -> None:
+        """Resume every flow a finished batch was verifying. Flows that left
+        _WAIT_VERIFY while an async batch was in flight (failed in place by
+        checkpoint serialization, or torn down) are skipped — their park is
+        gone and the result has nowhere to land."""
         for fsm, request, start, end in spans:
+            if fsm.state != _WAIT_VERIFY:
+                continue
             fsm_ok, error = True, None
             if isinstance(request, VerifySigRequest):
                 if not all(ok[start:end]):
@@ -1057,6 +1078,46 @@ class StateMachineManager:
                 except Exception as e:
                     fsm_ok, error = False, e
             fsm.deliver_verify_result(fsm_ok, error)
+
+    # -- the async pipeline (crypto/async_verify.py) -----------------------
+
+    def submit_pending_verifies(self) -> int:
+        """Hand the accumulated micro-batch to the async feeder thread and
+        return immediately (the pipelined counterpart of
+        flush_pending_verifies); returns the number of jobs submitted.
+        The parked flows stay in _WAIT_VERIFY until drain_async_verifies
+        delivers the completed batch on a later round."""
+        batch, self._verify_queue = self._verify_queue, []
+        self._verify_sig_count = 0
+        if not batch:
+            return 0
+        jobs, spans = self._build_verify_jobs(batch)
+        self.async_verify.submit(jobs, spans)
+        return len(jobs)
+
+    def drain_async_verifies(self) -> int:
+        """Deliver every batch the feeder thread has finished (run-loop
+        thread only — flow state crosses back here and nowhere else).
+        A batch whose verify RAISED rejects its waiting flows with the
+        error instead of hanging them. Returns batches delivered."""
+        svc = self.async_verify
+        if svc is None:
+            return 0
+        done = 0
+        for handle in svc.drain():
+            done += 1
+            self.metrics["verify_batches"] += 1
+            self.metrics["verify_sigs"] += len(handle.jobs)
+            if handle.error is not None:
+                for fsm, request, start, end in handle.context:
+                    if fsm.state != _WAIT_VERIFY:
+                        continue
+                    fsm.deliver_verify_result(False, handle.error)
+            else:
+                self._deliver_verify_results(handle.context, handle.ok)
+        if done:
+            self._pump()
+        return done
 
     # -- messaging ---------------------------------------------------------
 

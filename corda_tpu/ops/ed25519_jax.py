@@ -498,14 +498,23 @@ def precompute_batch_device(pubkeys, msgs, sigs, bucket: int | None = None):
             return np.frombuffer(raw, "<u4").reshape(8, b)
 
         return (words(raw_a), words(raw_r), words(raw_s), words(raw_m)), n
-    # Per-message check, not aggregate: mixed lengths summing to 32*n would
-    # silently re-split at 32-byte boundaries and verify against scrambled
-    # messages (round-2 advisor finding).
+    # Per-ITEM checks, not aggregate: mixed lengths summing to the right
+    # total would silently re-split at fixed boundaries and verify against
+    # scrambled lanes (round-2 advisor finding). Same order and messages
+    # as the native packer's want_len loop (pk -> msg -> sig per item) so
+    # either path rejects malformed input identically.
     raw = [bytes(m) for m in msgs]
     if len(raw) != n or len(pubkeys) != n:
         raise ValueError("pubkeys, msgs and sigs must have equal length")
-    if any(len(m) != 32 for m in raw):
-        raise ValueError("device-hash path requires 32-byte messages")
+    if b < n:
+        raise ValueError("bucket smaller than batch")
+    for pk, m, s in zip(pubkeys, raw, sigs):
+        if len(bytes(pk)) != 32:
+            raise ValueError("pubkeys must be 32 bytes")
+        if len(m) != 32:
+            raise ValueError("device-hash path requires 32-byte messages")
+        if len(bytes(s)) != 64:
+            raise ValueError("sigs must be 64 bytes")
     m_cat = b"".join(raw)
     _, _, pk, r_enc, s_raw = _pack_pk_rs(pubkeys, sigs, n, b)
     m_raw = np.zeros((b, 32), np.uint8)

@@ -26,6 +26,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import ed25519_jax, fe25519 as fe
 
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _NO_CHECK = {"check_vma": False}
+else:  # jax < 0.6: experimental path, and the kwarg was named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NO_CHECK = {"check_rep": False}
+
 __all__ = ["make_mesh", "sharded_verify_fn", "sharded_verify_hashed_fn",
            "verify_batch_sharded", "pad_to_devices"]
 
@@ -69,9 +76,9 @@ def _sharded_fn(graph_fn, mesh: Mesh):
     key = (graph_fn, mesh)
     fn = _FN_CACHE.get(key)
     if fn is None:
-        inner = jax.shard_map(
+        inner = _shard_map(
             graph_fn, mesh=mesh, in_specs=_IN_SPECS, out_specs=_OUT_SPEC,
-            check_vma=False,
+            **_NO_CHECK,
         )
         fn = _FN_CACHE[key] = jax.jit(inner)
     return fn

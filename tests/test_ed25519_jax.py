@@ -388,3 +388,41 @@ def test_native_pack_parity():
         native.pack_words(pks[:-1], msgs, sigs, bucket)  # length mismatch
     with pytest.raises(ValueError):
         native.pack_words(pks, msgs, sigs, 16)  # bucket < n
+
+
+def test_numpy_fallback_packer_rejects_per_item_like_native(monkeypatch):
+    """The numpy fallback of precompute_batch_device must reject malformed
+    inputs per-ITEM with the native packer's exact messages and order
+    (pk -> msg -> sig), so a host without the native core fails identically
+    instead of silently packing garbage lanes."""
+    monkeypatch.setattr(kernel, "_CPACK_CACHE", [None])  # force numpy path
+
+    pks, msgs, sigs = [], [], []
+    for i in range(4):
+        seed, pk = _keypair(500 + i)
+        m = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(seed, m))
+
+    with pytest.raises(ValueError, match="equal length"):
+        kernel.precompute_batch_device(pks[:-1], msgs, sigs, bucket=8)
+    with pytest.raises(ValueError, match="bucket smaller than batch"):
+        kernel.precompute_batch_device(pks, msgs, sigs, bucket=2)
+    with pytest.raises(ValueError, match="pubkeys must be 32 bytes"):
+        kernel.precompute_batch_device(
+            [b"\x00" * 31] + pks[1:], msgs, sigs, bucket=8)
+    with pytest.raises(ValueError, match="32-byte messages"):
+        kernel.precompute_batch_device(
+            pks, [b"short"] + msgs[1:], sigs, bucket=8)
+    with pytest.raises(ValueError, match="sigs must be 64 bytes"):
+        kernel.precompute_batch_device(
+            pks, msgs, [b"\x00" * 63] + sigs[1:], bucket=8)
+    # An item bad in several ways reports its FIRST failure (native order):
+    # the pk check fires before the msg check on the same index.
+    with pytest.raises(ValueError, match="pubkeys must be 32 bytes"):
+        kernel.precompute_batch_device(
+            [b"\x00" * 31] + pks[1:], [b"short"] + msgs[1:], sigs, bucket=8)
+    # And well-formed input still packs (the happy path stays intact).
+    arrays, n = kernel.precompute_batch_device(pks, msgs, sigs, bucket=8)
+    assert n == 4 and arrays[0].shape == (8, 8)

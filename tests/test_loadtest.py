@@ -132,6 +132,13 @@ def test_open_loop_latency_sweep(tmp_path):
     assert r.committed == 40
     assert r.p50_ms <= r.p90_ms <= r.p99_ms
     assert r.duration_s >= 0.6 * (40 / 40.0)
+    # Self-describing stamps (homogeneous: every value is a member stamp
+    # dict — the warm-wait scalar lives on the result object, not in here).
+    assert res.node_stamps and all(
+        isinstance(s, dict) for s in res.node_stamps.values())
+    stamp = next(iter(res.node_stamps.values()))
+    assert stamp["verifier"] is not None
+    assert stamp["pipeline_depth"] == 2  # async pipeline on by default
 
 
 @pytest.mark.slow
