@@ -667,7 +667,10 @@ def bench_raft_open_loop(rates=(30.0, 90.0, 150.0), n_tx=200,
     the simple-notary sweep so the two configs compare directly.
     node_stamps attribute each member's verify routing for the sweep —
     device_batches, pipeline depth, overlap ratio (the async-pipeline
-    numbers the flagship config is judged on)."""
+    numbers the flagship config is judged on) — plus the commit-pipeline
+    stamps, summarised once under "replication" from the leader's view:
+    entries_per_batch, replication RTT, reply-coalesce ratio, and the
+    transport burst sizes (ARCHITECTURE.md "Commit pipeline")."""
     from corda_tpu.tools.loadtest import run_latency_sweep
 
     sweep = run_latency_sweep(rates=rates, n_tx=n_tx, width=4,
@@ -678,12 +681,47 @@ def bench_raft_open_loop(rates=(30.0, 90.0, 150.0), n_tx=200,
             "notary_device": notary_device,
             "coalesce_ms": 10.0,
             "node_stamps": sweep.node_stamps,
+            "replication": _replication_summary(sweep.node_stamps),
             "rates": {
                 f"{rate:g}_tx_s": {
                     "p50_ms": r.p50_ms, "p90_ms": r.p90_ms,
                     "p99_ms": r.p99_ms, "tx_per_sec": r.tx_per_sec,
                     "committed": r.committed}
                 for rate, r in sweep.items()}}
+
+
+def _replication_summary(node_stamps):
+    """One commit-pipeline summary from the member that actually drove
+    replication: prefer the stamp whose raft role is "leader", fall back
+    to the member with the most append frames (a leader change mid-sweep
+    leaves two partial leader views; the busier one wrote the batches).
+    Returns None when no member carries a raft stamp — the guard test and
+    the bench contract both treat that as "replication stamps missing"."""
+    best_name, best, best_frames = None, None, -1
+    for name, stamp in (node_stamps or {}).items():
+        raft = (stamp or {}).get("raft") or {}
+        if not raft:
+            continue
+        frames = raft.get("append_frames") or 0
+        lead = raft.get("role") == "leader"
+        if best is None or (lead and best.get("role") != "leader") \
+                or (lead == (best.get("role") == "leader")
+                    and frames > best_frames):
+            best_name, best, best_frames = name, raft, frames
+    if best is None:
+        return None
+    transport = (node_stamps.get(best_name) or {}).get("transport") or {}
+    return {"member": best_name,
+            "role": best.get("role"),
+            "group_commit": best.get("group_commit"),
+            "group_commits": best.get("group_commits"),
+            "entries_per_batch": best.get("entries_per_batch"),
+            "append_frames": best.get("append_frames"),
+            "append_entries_sent": best.get("append_entries_sent"),
+            "replication_rtt_ms_avg": best.get("replication_rtt_ms_avg"),
+            "reply_coalesce_ratio": best.get("reply_coalesce_ratio"),
+            "outbox_burst_avg": transport.get("outbox_burst_avg"),
+            "bridge_flush_avg": transport.get("bridge_flush_avg")}
 
 
 class BenchTimeout(Exception):

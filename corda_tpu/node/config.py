@@ -52,6 +52,24 @@ class BatchConfig:
 
 
 @dataclass(frozen=True)
+class RaftConfig:
+    """Consensus hot-path policy (services/raft.py commit pipeline)."""
+
+    # Group commit: the leader merges every PutAllCommand submitted in a
+    # scheduling round into ONE batched log entry (PutAllBatch) — one log
+    # append/fsync, one AppendEntries slot, one apply pass for the whole
+    # burst, with per-request conflict isolation inside the batch. False
+    # restores the one-command-per-entry path.
+    group_commit: bool = True
+    # Pipelined replication: how many log entries may be streamed to a
+    # follower beyond its acked match position before the leader pauses
+    # and probes with heartbeats (per-peer in-flight window).
+    pipeline_window: int = 1024
+    # Entries per AppendEntries frame when streaming a tail.
+    append_chunk: int = 256
+
+
+@dataclass(frozen=True)
 class NodeConfig:
     name: str
     base_dir: Path
@@ -68,6 +86,7 @@ class NodeConfig:
     web_port: int | None = None  # HTTP API (status/metrics/attachments)
     verifier: str = "cpu"  # cpu | jax | jax-shadow | jax-sharded
     batch: BatchConfig = field(default_factory=BatchConfig)
+    raft: RaftConfig = field(default_factory=RaftConfig)
     # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
     rpc_users: tuple = ()
     # CorDapp modules: imported at node start so their @register_flow /
@@ -89,7 +108,7 @@ class NodeConfig:
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
                  "network_map", "map_service", "map_node", "tls", "web_port",
-                 "verifier", "batch", "rpc_users", "cordapps"}
+                 "verifier", "batch", "raft", "rpc_users", "cordapps"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -103,6 +122,7 @@ class NodeConfig:
             raise ValueError("raft-* notaries need a raft_cluster name list")
         nm = raw.get("network_map")
         batch = raw.get("batch", {})
+        raft = raw.get("raft", {})
         return NodeConfig(
             name=raw["name"],
             base_dir=base,
@@ -124,6 +144,11 @@ class NodeConfig:
                 coalesce_ms=float(batch.get("coalesce_ms", 0.0)),
                 async_verify=bool(batch.get("async_verify", True)),
                 async_depth=int(batch.get("async_depth", 2)),
+            ),
+            raft=RaftConfig(
+                group_commit=bool(raft.get("group_commit", True)),
+                pipeline_window=int(raft.get("pipeline_window", 1024)),
+                append_chunk=int(raft.get("append_chunk", 256)),
             ),
             rpc_users=tuple(
                 dict(u) for u in raw.get("rpc_users", ())),

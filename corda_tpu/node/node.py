@@ -182,6 +182,7 @@ class Node:
                     messaging=self.messaging,
                     db=self.db,
                     apply_command=make_apply_command(self.db),
+                    config=config.raft,  # commit-pipeline policy ([raft])
                 )
                 self.uniqueness_provider = RaftUniquenessProvider(
                     self.raft_member, pump=self._raft_pump)

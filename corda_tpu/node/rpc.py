@@ -194,6 +194,17 @@ class NodeRpcOps:
             # the adaptive crossover state; None in synchronous mode.
             "async_verify": (smm.async_verify.stats()
                              if smm.async_verify is not None else None),
+            # Commit-pipeline stamps (services/raft.py): group-commit
+            # entries/batch, pipelined-replication frames, reply coalescing,
+            # replication RTT; None on non-raft nodes.
+            "raft": (self._node.raft_member.stamp()
+                     if getattr(self._node, "raft_member", None) is not None
+                     else None),
+            # Transport burst stamps (messaging/tcp.py): outbox executemany
+            # bursts + bridge writev flushes; None on non-TCP fakes.
+            "transport": (self._node.messaging.transport_stats()
+                          if hasattr(self._node.messaging, "transport_stats")
+                          else None),
             # Per-flow-name completion timings (count/total_ms/max_ms) —
             # the per-flow half of the reference's JMX metrics export.
             "flow_timings": {k: dict(v)

@@ -17,6 +17,14 @@ verifies BEFORE flushing AppendEntries, so tx N's replication overlaps
 tx N+1's device verify without this module doing anything special; keep
 new service-side work behind those same seams or it re-serialises the
 round (see ARCHITECTURE.md "Async verify pipeline").
+
+The commit seam is also the group-commit seam (ARCHITECTURE.md "Commit
+pipeline"): every notary flow whose commit_async submits during one
+poll_services pass rides ONE PutAllBatch log entry on the raft leader —
+conflict isolation stays per-request (a double-spend in the batch rejects
+alone, its siblings commit), so nothing here needs to sort or segregate
+requests before committing. Keep commits going through commit_async one
+request at a time; batching is the consensus layer's job.
 """
 
 from __future__ import annotations

@@ -752,7 +752,14 @@ class StateMachineManager:
         self.changes = EventLog()  # bounded flow/progress event feed
         # Metrics (reference: StateMachineManager.kt:105-113)
         self.metrics = {"started": 0, "finished": 0, "checkpointing_rate": 0,
-                        "verify_batches": 0, "verify_sigs": 0}
+                        "verify_batches": 0, "verify_sigs": 0,
+                        # ServiceRequest seam (Raft commit_async etc.):
+                        # completions per poll pass attribute how many
+                        # commits a round hands the consensus group-commit
+                        # buffer at once (the upstream half of the raft
+                        # entries_per_batch stamp).
+                        "service_polls": 0, "service_completions": 0,
+                        "service_round_max": 0}
         # Per-flow-name timing aggregates (the JMX/Jolokia capability the
         # reference exports per-MBean, reference: Node.kt:313 — here over
         # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
@@ -977,9 +984,19 @@ class StateMachineManager:
     def _enqueue_service(self, fsm: FlowStateMachine, poll: Callable) -> None:
         self._service_queue.append((fsm, poll))
 
+    @property
+    def service_pending(self) -> int:
+        """Flows parked on a ServiceRequest (e.g. awaiting a raft commit)."""
+        return len(self._service_queue)
+
     def poll_services(self) -> int:
         """Poll every parked ServiceRequest; resume flows whose operation
-        finished. Called from the node's run loop. Returns completions."""
+        finished. Called from the node's run loop. Returns completions.
+
+        This is the round -> group-submit seam of the commit pipeline: every
+        commit_async poll that (re)submits during ONE pass lands in the raft
+        leader's pending batch together, and flush_appends seals them into
+        one group-commit entry right after (node.run_once ordering)."""
         if not self._service_queue:
             return 0
         done = 0
@@ -999,7 +1016,11 @@ class StateMachineManager:
                 fsm.deliver_service_result(value=outcome)
                 done += 1
         self._service_queue = still_pending
+        self.metrics["service_polls"] += 1
         if done:
+            self.metrics["service_completions"] += done
+            self.metrics["service_round_max"] = max(
+                self.metrics["service_round_max"], done)
             self._pump()
         return done
 

@@ -234,6 +234,8 @@ def _member_stamp(metrics: dict, device: str) -> dict:
     in_loop = stage.get("verify", 0.0) or 0.0
     overlap = (round(wall / (wall + in_loop), 3)
                if (wall + in_loop) > 0 else None)
+    raft = metrics.get("raft") or {}
+    transport = metrics.get("transport") or {}
     return {"verifier": metrics.get("verifier"),
             "kernel_backend": metrics.get("kernel_backend"),
             "device": device,
@@ -243,7 +245,19 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             "device_min_sigs": metrics.get("verify_device_min_sigs"),
             "async_verify": av or None,
             "pipeline_depth": av.get("depth"),
-            "overlap_ratio": overlap}
+            "overlap_ratio": overlap,
+            # Commit-pipeline stamps (ARCHITECTURE.md "Commit pipeline"):
+            # group-commit density, wire RTT, and coalescing ratios, so a
+            # latency number can't travel without the replication shape
+            # that produced it.
+            "raft": raft or None,
+            "raft_role": raft.get("role"),
+            "entries_per_batch": raft.get("entries_per_batch"),
+            "replication_rtt_ms_avg": raft.get("replication_rtt_ms_avg"),
+            "reply_coalesce_ratio": raft.get("reply_coalesce_ratio"),
+            "transport": transport or None,
+            "outbox_burst_avg": transport.get("outbox_burst_avg"),
+            "bridge_flush_avg": transport.get("bridge_flush_avg")}
 
 
 def run_loadtest_multiprocess(

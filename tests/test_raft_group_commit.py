@@ -1,0 +1,392 @@
+"""Commit pipeline: group commit, pipelined replication, coalesced replies.
+
+Unit-level coverage of the ARCHITECTURE.md "Commit pipeline" contract over
+an in-process message router (no sockets, no Node processes):
+
+* a leader's round of submissions seals into ONE PutAllBatch log entry,
+  with per-request conflict isolation inside the batch;
+* commands buffered when leadership is lost mid-batch bounce back and
+  recommit in order through the new leader (forward + reply coalescing);
+* a redelivered ClientReplyBatch is absorbed idempotently;
+* the pipelined broadcast streams a long tail once, in bounded chunks,
+  with probe heartbeats once the window is full;
+* hint-less AppendReply failures back next_index off exponentially;
+* [raft] group_commit=false preserves the per-command sync path;
+* _Outbox.append_many is atomic across a crash between the executemany
+  and the commit durability point (full replay, never a prefix).
+"""
+
+import json
+import types
+
+from corda_tpu.contracts.structures import StateRef
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.party import Party
+from corda_tpu.node.config import RaftConfig
+from corda_tpu.node.messaging.tcp import _Outbox
+from corda_tpu.node.services.persistence import NodeDatabase
+from corda_tpu.node.services.raft import (
+    AppendEntries,
+    AppendReply,
+    ClientReply,
+    ClientReplyBatch,
+    PutAllBatch,
+    PutAllCommand,
+    RaftMember,
+    make_apply_command,
+)
+from corda_tpu.serialization.codec import deserialize, serialize
+
+PARTY = Party("Client", KeyPair.generate(b"\x01" * 32).public.composite)
+
+
+def cmd(ref_seed: bytes, tx_seed: bytes, rid: bytes) -> PutAllCommand:
+    ref = StateRef(SecureHash.sha256(ref_seed), 0)
+    return PutAllCommand((ref,), SecureHash.sha256(tx_seed), PARTY, rid)
+
+
+class Net:
+    """Synchronous in-process router: member name IS its address."""
+
+    def __init__(self):
+        self.handlers = {}
+        self.queue = []
+
+    def deliver_all(self):
+        while self.queue:
+            to, data, sender = self.queue.pop(0)
+            handler = self.handlers.get(to)
+            if handler is not None:
+                handler(types.SimpleNamespace(data=data, sender=sender))
+
+
+class FakeMessaging:
+    def __init__(self, net: Net, addr: str):
+        self.net, self.addr = net, addr
+        self.sent = []  # (to, frame_bytes) — for wire-shape assertions
+
+    def add_message_handler(self, topic, session_id, callback):
+        self.net.handlers[self.addr] = callback
+
+    def send(self, topic_session, data, to):
+        self.sent.append((to, data))
+        self.net.queue.append((to, data, self.addr))
+
+
+def make_member(tmp_path, net, name, peers, clock, config=None):
+    db = NodeDatabase(tmp_path / f"{name}.db")
+    return RaftMember(name, peers, FakeMessaging(net, name), db,
+                      make_apply_command(db), clock=clock, config=config)
+
+
+def make_trio(tmp_path, net, clock, config=None):
+    names = ("A", "B", "C")
+    return {n: make_member(tmp_path, net, n,
+                           {p: p for p in names if p != n}, clock, config)
+            for n in names}
+
+
+def elect(net, member, t):
+    t[0] += 100.0  # past any election deadline; only `member` is ticked
+    member.tick()
+    net.deliver_all()  # votes out, replies back, victory broadcast handled
+    assert member.role == "leader"
+
+
+def settle(net, members, rounds=6):
+    for _ in range(rounds):
+        for m in members:
+            m.flush_appends()
+        net.deliver_all()
+
+
+def test_group_commit_seals_one_entry_with_conflict_isolation(tmp_path):
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])
+    leader = members["A"]
+    elect(net, leader, t)
+
+    # Two commands race for the same state ref; a third is independent.
+    shared = StateRef(SecureHash.sha256(b"shared"), 0)
+    c1 = PutAllCommand((shared,), SecureHash.sha256(b"tx1"), PARTY, b"r1")
+    c2 = PutAllCommand((shared,), SecureHash.sha256(b"tx2"), PARTY, b"r2")
+    c3 = cmd(b"free", b"tx3", b"r3")
+    for c in (c1, c2, c3):
+        leader.submit(c)
+    (log_before,) = leader.db.conn.execute(
+        "SELECT COUNT(*) FROM raft_log").fetchone()
+    leader.flush_appends()
+    (log_after,) = leader.db.conn.execute(
+        "SELECT COUNT(*) FROM raft_log").fetchone()
+    assert log_after == log_before + 1  # the whole round is ONE log entry
+    (blob,) = leader.db.conn.execute(
+        "SELECT blob FROM raft_log ORDER BY idx DESC LIMIT 1").fetchone()
+    entry = deserialize(bytes(blob))
+    assert isinstance(entry, PutAllBatch)
+    assert [c.request_id for c in entry.commands] == [b"r1", b"r2", b"r3"]
+
+    settle(net, members.values())
+    # Per-request conflict isolation: the loser rejects ALONE.
+    assert leader.decided[b"r1"].ok is True
+    assert leader.decided[b"r2"].ok is False
+    assert leader.decided[b"r2"].conflict is not None
+    assert leader.decided[b"r3"].ok is True
+    # Batched apply replicated identically on every member.
+    for m in members.values():
+        assert m.last_applied == leader.last_applied
+        (n,) = m.db.conn.execute(
+            "SELECT COUNT(*) FROM committed_states").fetchone()
+        assert n == 2  # shared (first committer) + free
+
+    stamp = leader.stamp()
+    assert stamp["group_commits"] == 1
+    assert stamp["group_commands"] == 3
+    assert stamp["entries_per_batch"] == 3.0
+    assert stamp["replication_rtt_ms_avg"] is not None
+    json.dumps(stamp)  # the node_metrics contract: plain JSON types only
+
+
+def test_leader_change_mid_batch_bounces_then_recommits(tmp_path):
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])
+    old = members["A"]
+    elect(net, old, t)
+
+    c1, c2 = cmd(b"s1", b"t1", b"r1"), cmd(b"s2", b"t2", b"r2")
+    old.submit(c1)
+    old.submit(c2)
+    assert len(old._pending_batch) == 2
+
+    # A higher term arrives before the round flushes: the buffered commands
+    # were never sealed, so they must bounce (ok=False), not linger.
+    old._become_follower(old.term + 1, leader="B")
+    assert old._pending_batch == [] and not old._appending
+    for rid in (b"r1", b"r2"):
+        assert old.decided[rid].ok is False
+        assert old.decided[rid].conflict is None  # retryable, not a conflict
+    (log_len,) = old.db.conn.execute(
+        "SELECT COUNT(*) FROM raft_log").fetchone()
+    assert log_len == 0  # nothing half-sealed survived the change
+
+    # The client resubmits through the deposed member; the round's commands
+    # forward to the new leader as ONE ClientCommitBatch and commit in the
+    # submission order.
+    new = members["B"]
+    elect(net, new, t)
+    old.decided.clear()
+    old.submit(c1)
+    old.submit(c2)
+    old.flush_appends()
+    settle(net, members.values())
+    assert old.decided[b"r1"].ok is True
+    assert old.decided[b"r2"].ok is True
+    assert old.metrics["forward_frames"] == 1
+    assert old.metrics["forward_commands"] == 2
+    # The decisions came back coalesced: one multi-outcome frame.
+    assert new.metrics["reply_frames"] == 1
+    assert new.metrics["reply_commands"] == 2
+    batches = [deserialize(f) for _to, f in new.messaging.sent
+               if isinstance(deserialize(f), ClientReplyBatch)]
+    assert len(batches) == 1
+    assert {r.request_id for r in batches[0].replies} == {b"r1", b"r2"}
+    # Order across the leader change: batch order == resubmission order.
+    (blob,) = new.db.conn.execute(
+        "SELECT blob FROM raft_log ORDER BY idx DESC LIMIT 1").fetchone()
+    entry = deserialize(bytes(blob))
+    assert [c.request_id for c in entry.commands] == [b"r1", b"r2"]
+
+
+def test_reply_batch_redelivery_is_idempotent(tmp_path):
+    net = Net()
+    member = make_member(tmp_path, net, "A", {}, lambda: 0.0)
+    batch = serialize(ClientReplyBatch((
+        ClientReply(b"r1", True, None, "A"),
+        ClientReply(b"r2", False, None, "A")))).bytes
+    deliver = lambda: member._on_message(  # noqa: E731
+        types.SimpleNamespace(data=batch, sender="X"))
+
+    deliver()
+    first = dict(member.decided)
+    assert first[b"r1"].ok is True and first[b"r2"].ok is False
+    # The transport is at-least-once: the SAME frame arrives again — both
+    # before and after a waiting request consumed its decision.
+    deliver()
+    assert dict(member.decided) == first
+    member.decided.pop(b"r1")  # a poll consumed its id (pops at most once)
+    deliver()
+    assert member.decided[b"r1"].ok is True  # re-recorded, nothing applied
+
+
+def test_pipelined_broadcast_streams_tail_once_in_chunks(tmp_path):
+    net, t = Net(), [0.0]
+    member = make_member(
+        tmp_path, net, "A", {"B": "B"}, lambda: t[0],
+        config=RaftConfig(append_chunk=4, pipeline_window=8))
+    # Leadership without an election dance: B never answers, so the stream
+    # position is driven purely by _broadcast_append's own bookkeeping.
+    member.role, member.leader_name, member.term = "leader", "A", 1
+    for i in range(1, 11):
+        member._log_append(i, 1, cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
+    member._next_index = {"B": 1}
+    member._match_index = {"B": 0}
+    member._sent_index = {"B": 0}
+
+    def appends():
+        out = []
+        for _to, frame in member.messaging.sent:
+            payload = deserialize(frame)
+            if isinstance(payload, AppendEntries):
+                out.append(payload)
+        return out
+
+    member._broadcast_append()
+    member._broadcast_append()
+    member._broadcast_append()
+    first, second, third = appends()
+    # Chunked streaming: 4 + 4, then the window (8 un-acked) is full and
+    # the third frame is a pure probe at the stream head — the tail is
+    # NEVER re-sent wholesale per tick.
+    assert (first.prev_index, len(first.entries)) == (0, 4)
+    assert (second.prev_index, len(second.entries)) == (4, 4)
+    assert (third.prev_index, third.entries) == (8, ())
+    assert member.metrics["append_entries_sent"] == 8
+
+    # An ack opens the window: only the UNSENT remainder streams out.
+    member._on_append_reply(AppendReply(1, True, 8, "B"))
+    member._broadcast_append()
+    fourth = appends()[-1]
+    assert (fourth.prev_index, len(fourth.entries)) == (8, 2)
+    # Wire entries are the log's own encoded blobs (zero codec work): a
+    # follower could insert them verbatim.
+    idx9 = deserialize(fourth.entries[0][1])
+    assert idx9.request_id == b"r9"
+
+
+def test_hintless_append_failure_backs_off_exponentially(tmp_path):
+    net = Net()
+    member = make_member(tmp_path, net, "A", {"B": "B"}, lambda: 0.0)
+    member.role, member.leader_name, member.term = "leader", "A", 1
+    member._next_index = {"B": 100}
+    member._match_index = {"B": 0}
+    member._sent_index = {"B": 120}
+
+    positions = []
+    for _ in range(5):
+        member._on_append_reply(AppendReply(1, False, 0, "B", hint_index=-1))
+        positions.append(member._next_index["B"])
+        assert member._sent_index["B"] == member._next_index["B"] - 1
+    # Doubling window: O(log tail) convergence instead of decrement-by-one.
+    assert positions == [99, 97, 93, 85, 69]
+    assert member._backoff["B"] == 32
+    # Success resets the backoff (and the stream floor follows the match).
+    member._on_append_reply(AppendReply(1, True, 98, "B"))
+    assert "B" not in member._backoff
+    assert member._next_index["B"] == 99
+    # The cap: however long the divergence, a single step never exceeds
+    # the append chunk.
+    member._next_index["B"] = 10_000
+    member._sent_index["B"] = 9_999
+    for _ in range(20):
+        member._on_append_reply(AppendReply(1, False, 0, "B", hint_index=-1))
+    assert member._backoff["B"] == member.config.append_chunk == 256
+
+
+def test_group_commit_off_keeps_per_command_sync_path(tmp_path):
+    net, t = Net(), [0.0]
+    member = make_member(tmp_path, net, "A", {}, lambda: t[0],
+                         config=RaftConfig(group_commit=False))
+    elect(net, member, t)
+    for i in range(3):
+        member.submit(cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
+    # Sync path: every submission appended its OWN log entry immediately.
+    rows = member.db.conn.execute(
+        "SELECT blob FROM raft_log ORDER BY idx").fetchall()
+    assert len(rows) == 3
+    assert all(isinstance(deserialize(bytes(b)), PutAllCommand)
+               for (b,) in rows)
+    member.flush_appends()
+    for i in range(3):
+        assert member.decided[b"r%d" % i].ok is True
+    stamp = member.stamp()
+    assert stamp["group_commit"] is False
+    assert stamp["group_commits"] == 0
+
+
+def test_single_member_group_commit_and_stamp(tmp_path):
+    # peers={} is a quorum of one: the full submit -> seal -> commit ->
+    # apply pipeline runs in-process (the shape the bench guard test and
+    # any smoke harness lean on).
+    net, t = Net(), [0.0]
+    member = make_member(tmp_path, net, "A", {}, lambda: t[0])
+    elect(net, member, t)
+    for i in range(4):
+        member.submit(cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
+    member.flush_appends()
+    assert all(member.decided[b"r%d" % i].ok for i in range(4))
+    stamp = member.stamp()
+    assert stamp["entries_per_batch"] == 4.0
+    assert stamp["role"] == "leader"
+    json.dumps(stamp)
+
+
+def test_node_metrics_carries_raft_and_transport_stamps(tmp_path):
+    # End-to-end rpc wiring: a REAL raft node (cluster of one, TCP
+    # transport) exports both commit-pipeline stamp dicts via node_metrics
+    # — the exact path loadtest's _member_stamp reads over RPC.
+    import time
+
+    from corda_tpu.node.config import NodeConfig
+    from corda_tpu.node.node import Node
+    from corda_tpu.node.rpc import NodeRpcOps
+
+    node = Node(NodeConfig(name="Solo", base_dir=tmp_path / "Solo",
+                           notary="raft-simple", raft_cluster=("Solo",),
+                           network_map=tmp_path / "netmap.json")).start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while node.raft_member.role != "leader":
+            node.run_once(timeout=0.005)
+            assert time.monotonic() < deadline, "no leader"
+        metrics = NodeRpcOps(node).node_metrics()
+        assert metrics["raft"]["role"] == "leader"
+        assert metrics["raft"]["group_commit"] is True
+        assert "entries_per_batch" in metrics["raft"]
+        assert "outbox_burst_avg" in metrics["transport"]
+        json.dumps(metrics["raft"])
+        json.dumps(metrics["transport"])
+    finally:
+        node.stop()
+
+
+def test_append_many_crash_consistency_full_replay(tmp_path):
+    frames = [(b"id%d" % i, b"frame%d" % i) for i in range(5)]
+
+    # Crash between the executemany and the commit durability point: the
+    # rows are in the connection's open transaction but never durable.
+    db = NodeDatabase(tmp_path / "n.db")
+    outbox = _Outbox(db)
+    real_commit = db.commit
+    db.commit = lambda: (_ for _ in ()).throw(RuntimeError("power cut"))
+    try:
+        outbox.append_many("peer", frames)
+    except RuntimeError:
+        pass
+    db.commit = real_commit
+    db.conn.rollback()  # what process death does to an open transaction
+    db.close()
+
+    reopened = NodeDatabase(tmp_path / "n.db")
+    (n,) = reopened.conn.execute(
+        "SELECT COUNT(*) FROM outbox").fetchone()
+    assert n == 0  # never a prefix: the whole burst rolled back
+
+    # The caller's at-least-once resend replays the burst IN FULL.
+    outbox2 = _Outbox(reopened)
+    outbox2.append_many("peer", frames)
+    pending = outbox2.pending("peer")
+    assert [u for _s, u, _f in pending] == [u for u, _f in frames]
+    assert outbox2.stats["bursts"] == 1
+    assert outbox2.stats["burst_frames"] == 5
+    assert outbox2.stats["max_burst"] == 5
+    reopened.close()
