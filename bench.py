@@ -724,6 +724,51 @@ def _replication_summary(node_stamps):
             "bridge_flush_avg": transport.get("bridge_flush_avg")}
 
 
+def bench_chaos(n_tx=60, cluster_size=3, rate_tx_s=120.0):
+    """Chaos section (round 7): measured recovery under deterministic fault
+    injection. Two runs over the in-process raft cluster (real TCP +
+    sqlite), clients notarising through the deadline-bounded retry flow:
+
+    * leader_kill — the raft LEADER is killed mid-burst and rebuilt from
+      disk; recovery is the gap from the kill to the first completion
+      after it, and the exactly-once audit (client outcomes AND the
+      cluster's committed_states row count) must hold across the change.
+    * lossy_open_loop — the builtin "lossy" plan (seeded 5% transport.send
+      drop) armed, open-loop paced; p99 shows what redelivery costs.
+
+    Headline keys are hoisted to the section top so the bench contract
+    (leader_kill_recovery_s, faults_injected, lossy p99) greps flat."""
+    from corda_tpu.tools.loadtest import run_chaos_loadtest
+
+    out = {}
+    kill = run_chaos_loadtest(n_tx=n_tx, cluster_size=cluster_size,
+                              kill_leader=True, rate_tx_s=rate_tx_s)
+    out["leader_kill"] = {
+        "exactly_once": kill.exactly_once,
+        "tx_committed": kill.tx_committed,
+        "tx_rejected": kill.tx_rejected,
+        "tx_unresolved": kill.tx_unresolved,
+        "cluster_committed": kill.cluster_committed,
+        "recovery_s": kill.leader_kill_recovery_s,
+        "p99_ms": kill.p99_ms,
+        "disruptions": kill.disruptions,
+    }
+    lossy = run_chaos_loadtest(plan="lossy", n_tx=n_tx,
+                               cluster_size=cluster_size,
+                               rate_tx_s=rate_tx_s)
+    out["lossy_open_loop"] = {
+        "exactly_once": lossy.exactly_once,
+        "tx_committed": lossy.tx_committed,
+        "rate_tx_s": rate_tx_s,
+        "p50_ms": lossy.p50_ms,
+        "p99_ms": lossy.p99_ms,
+    }
+    out["leader_kill_recovery_s"] = kill.leader_kill_recovery_s
+    out["faults_injected"] = lossy.faults_injected
+    out["lossy_open_loop_p99_ms"] = lossy.p99_ms
+    return out
+
+
 class BenchTimeout(Exception):
     pass
 
@@ -988,6 +1033,13 @@ def _run_host_only_phases(report: dict,
             raise
         except Exception as e:
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
+    set_phase("chaos")
+    try:
+        report["chaos"] = bench_chaos()
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["chaos"] = {"error": f"{type(e).__name__}: {e}"}
     set_phase("cpu_oracle")
     pks, msgs, sigs, _ = make_corpus()
     report["cpu_oracle_sigs_per_sec"] = round(
@@ -1173,6 +1225,13 @@ def _run_phases(report: dict) -> None:
             raise
         except Exception as e:
             configs[name] = {"error": f"{type(e).__name__}: {e}"}
+    set_phase("chaos")
+    try:
+        report["chaos"] = bench_chaos()
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["chaos"] = {"error": f"{type(e).__name__}: {e}"}
     set_phase("done")
 
 

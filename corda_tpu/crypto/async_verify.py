@@ -46,6 +46,7 @@ import time
 from typing import Any, Sequence
 
 from .provider import BatchVerifier, VerifyJob
+from ..testing import faults as _faults
 
 
 class VerifyBatchHandle:
@@ -281,6 +282,15 @@ class AsyncVerifyService:
             # verify_batch caller in async mode, so the delta is exact.
             before = getattr(self.verifier, "device_batches", 0) or 0
             try:
+                if _faults.ACTIVE is not None:
+                    act = _faults.ACTIVE.fire("verify.device")
+                    if act is not None:
+                        action, delay_s = act
+                        if action == "slow" and delay_s > 0:
+                            time.sleep(delay_s)
+                        elif action in ("fail", "raise"):
+                            raise RuntimeError(
+                                "fault injected: device verifier failure")
                 item.ok = self.verifier.verify_batch(item.jobs)
             except BaseException as e:  # noqa: BLE001 — crossed to the loop
                 # The exception must cross back to the run loop and reject

@@ -218,8 +218,15 @@ class DeviceRoutedVerifier(BatchVerifier):
         self.host_batches = 0
         self.device_batches = 0
         # node.py _warm_verifier_maybe installs its done-event here;
-        # None (the default) means no gate.
+        # None (the default) means no gate. degrade_device() reuses the
+        # same gate to host-route while the device tier is suspect.
         self.device_gate = None
+        # Degrade bookkeeping (degrade_device): times the device tier was
+        # demoted after a failure, and re-probe outcomes.
+        self.degraded = 0
+        self.reprobes_ok = 0
+        self.reprobes_failed = 0
+        self._reprobe_thread = None
 
     def verify_batch(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         if not jobs:
@@ -337,6 +344,74 @@ class MeshVerifier(DeviceRoutedVerifier):
         for n in WARM_SIZES:
             sharded.verify_batch_sharded([bytes(32)] * n, [bytes(32)] * n,
                                          [bytes(64)] * n, self.mesh)
+
+
+def host_verify(jobs: Sequence[VerifyJob]) -> np.ndarray:
+    """Verify a batch on the host tier regardless of any verifier's routing
+    state — the degrade path's re-verify (oracle-exact accept set, so a
+    batch the device would have accepted is accepted here too)."""
+    return _dispatch_mixed(jobs, CpuVerifier._verify_ed25519_host)
+
+
+# Seconds a degraded device tier stays demoted before the background
+# re-probe tries the device path again.
+DEVICE_REPROBE_COOLDOWN_S_DEFAULT = 5.0
+
+
+def degrade_device(verifier, cooldown_s: float | None = None) -> bool:
+    """Demote a device-backed verifier to its host tier after a device-path
+    failure, and schedule a cooldown re-probe that re-opens the gate once
+    the device answers again.
+
+    Closes (or installs) ``verifier.device_gate`` — every future batch
+    host-routes — then starts a daemon thread that sleeps ``cooldown_s``
+    (default ``CORDA_TPU_DEVICE_REPROBE_COOLDOWN_S`` or 5 s), runs the
+    verifier's own device path on a throwaway batch, and sets the gate on
+    success; on failure it keeps the gate closed and retries after another
+    cooldown. Returns False (no-op) for verifiers without a device tier.
+    Safe to call repeatedly: a second failure while a re-probe is pending
+    only bumps the counter."""
+    if getattr(verifier, "device_min_sigs", None) is None:
+        return False
+    import threading
+    import time as _t
+
+    gate = getattr(verifier, "device_gate", None)
+    if gate is None:
+        gate = threading.Event()
+        verifier.device_gate = gate
+    probing = getattr(verifier, "_reprobe_thread", None)
+    already_probing = (not gate.is_set() and probing is not None
+                       and probing.is_alive())
+    gate.clear()
+    verifier.degraded = getattr(verifier, "degraded", 0) + 1
+    if already_probing:
+        return True
+    if cooldown_s is None:
+        cooldown_s = float(os.environ.get(
+            "CORDA_TPU_DEVICE_REPROBE_COOLDOWN_S",
+            DEVICE_REPROBE_COOLDOWN_S_DEFAULT))
+
+    def _reprobe() -> None:
+        # Garbage jobs: the probe cares that the device path ANSWERS (an
+        # all-False result is fine), not that signatures validate.
+        n = max(2, int(getattr(verifier, "device_min_sigs", 2) or 2))
+        probe = [VerifyJob(bytes(32), bytes(32), bytes(64))] * n
+        while not gate.is_set():
+            _t.sleep(cooldown_s)
+            try:
+                verifier._verify_ed25519_device(probe)
+            except Exception:
+                verifier.reprobes_failed = getattr(
+                    verifier, "reprobes_failed", 0) + 1
+                continue
+            verifier.reprobes_ok = getattr(verifier, "reprobes_ok", 0) + 1
+            gate.set()
+
+    t = threading.Thread(target=_reprobe, daemon=True, name="verify-reprobe")
+    verifier._reprobe_thread = t
+    t.start()
+    return True
 
 
 _default: BatchVerifier | None = None

@@ -91,9 +91,15 @@ class NotaryUnavailable(NotaryError):
     """The notary could not decide in time (e.g. a Raft leadership episode
     outlasted the commit window). RETRYABLE: unlike the other errors this
     says nothing about the transaction — resubmitting the same tx later is
-    safe and expected (commit is idempotent, first-committer-wins)."""
+    safe and expected (commit is idempotent, first-committer-wins).
+
+    leader_hint: the legal name of the cluster member the service believes
+    is the current Raft leader (None when unknown) — a retrying client can
+    re-send straight to the leader instead of waiting out another redirect
+    round trip."""
 
     reason: str = ""
+    leader_hint: str | None = None
 
     def __str__(self):
         return f"Notary service temporarily unavailable: {self.reason}"
@@ -140,8 +146,14 @@ class NotaryClientFlow(FlowLogic):
     Progress steps mirror the reference's NotaryFlow tracker
     (NotaryFlow.kt REQUESTING/VALIDATING)."""
 
-    def __init__(self, stx: SignedTransaction):
+    def __init__(self, stx: SignedTransaction, via: Party | None = None):
         self.stx = stx
+        # Optional override of WHICH cluster member receives the request
+        # (leader redirect): the tx's notary identity still governs state
+        # checks, but the wire request goes to `via`. Cluster members are
+        # mutually trusted replicas of one service, so a signature by the
+        # via-member's service key is accepted.
+        self.via = via
         self.VERIFYING = Step("Verifying our signatures")
         self.REQUESTING = Step("Requesting signature by notary service")
         self.VALIDATING = Step("Validating response from notary service")
@@ -168,14 +180,15 @@ class NotaryClientFlow(FlowLogic):
             ) from e
 
         self.progress_tracker.current_step = self.REQUESTING
+        target = self.via if self.via is not None else notary_party
         request = SignRequest(self.stx, self.service_hub.my_identity)
-        response = yield self.send_and_receive(notary_party, request)
+        response = yield self.send_and_receive(target, request)
         self.progress_tracker.current_step = self.VALIDATING
         result = response.unwrap()
 
         if isinstance(result, NotarySuccess):
             sig = result.sig
-            if sig.by not in notary_party.owning_key.keys:
+            if sig.by not in target.owning_key.keys:
                 raise FlowException("Invalid signer for the notary result")
             # Validate through the verify pump: N concurrent clients share
             # one kernel call instead of N host-oracle verifications
@@ -191,30 +204,83 @@ class NotaryClientFlow(FlowLogic):
         )
 
 
+def _resolve_member(flow: FlowLogic, legal_name: str) -> Party | None:
+    """Map a leader_hint legal name to a Party via the network map."""
+    try:
+        cache = flow.service_hub.network_map_cache
+        for info in cache.party_nodes:
+            if info.legal_identity.name == legal_name:
+                return info.legal_identity
+    except Exception:
+        pass
+    return None
+
+
+def _timer_poll(wake_at: float):
+    """Non-blocking in-flow backoff: a ServiceRequest poll that stays
+    pending until `wake_at` (time.monotonic). Sleeping in place would
+    starve the run loop the retry depends on."""
+    import time as _time
+
+    return lambda: (True if _time.monotonic() >= wake_at else None)
+
+
 def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
-                        retries: int = 2, on_attempt=None):
+                        retries: int = 2, on_attempt=None,
+                        deadline_s: float | None = None,
+                        backoff_s: float = 0.1,
+                        max_backoff_s: float = 2.0):
     """yield-from helper: notarise `stx` via a fresh NotaryClientFlow per
     attempt, retrying ONLY the RETRYABLE NotaryUnavailable error (a
     consensus window elapsing says nothing about the tx, and commit is
     idempotent first-committer-wins). A fresh sub-flow per attempt matters:
     each one opens its own session, because the service flow ends after
     replying. `on_attempt(notary_flow)` lets callers hook up progress
-    trackers. The PRODUCT call sites (FinalityFlow, NotaryChangeFlow) share
-    this policy; the load/bench tools (loadgen, loadtest, demo_cordapp)
-    deliberately call NotaryClientFlow raw — retries there would mask the
-    availability behaviour they exist to measure."""
+    trackers.
+
+    The retry budget is bounded two ways: `retries` counts attempts, and
+    `deadline_s` (when set) REPLACES the count with a wall-clock budget —
+    retry until the deadline, however many attempts that is. Between
+    attempts the flow parks on a ServiceRequest timer (exponential backoff
+    from `backoff_s` up to `max_backoff_s`) instead of hammering a cluster
+    mid-election. When the failure carries a `leader_hint` (the Raft
+    provider knows who leads now), the next attempt is sent straight to
+    that member via NotaryClientFlow(via=...) instead of re-traversing a
+    redirect.
+
+    The load/bench tools (loadgen, loadtest, demo_cordapp) deliberately
+    call NotaryClientFlow raw — retries there would mask the availability
+    behaviour they exist to measure."""
+    import time as _time
+
+    deadline = None if deadline_s is None else _time.monotonic() + deadline_s
     attempt = 0
+    backoff = backoff_s
+    via: Party | None = None
     while True:
-        notary_flow = NotaryClientFlow(stx)
+        notary_flow = NotaryClientFlow(stx, via=via)
         if on_attempt is not None:
             on_attempt(notary_flow)
         try:
             return (yield from flow.sub_flow(notary_flow))
         except NotaryException as e:
-            if isinstance(e.error, NotaryUnavailable) and attempt < retries:
-                attempt += 1
-                continue
-            raise
+            if not isinstance(e.error, NotaryUnavailable):
+                raise
+            attempt += 1
+            now = _time.monotonic()
+            if (deadline is None and attempt > retries) or \
+                    (deadline is not None and now >= deadline):
+                raise
+            hint = getattr(e.error, "leader_hint", None)
+            if hint:
+                via = _resolve_member(flow, hint) or via
+            if backoff > 0:
+                wake_at = now + min(backoff, max_backoff_s)
+                if deadline is not None:
+                    wake_at = min(wake_at, deadline)
+                yield flow.service_request(
+                    lambda wake_at=wake_at: _timer_poll(wake_at))
+                backoff = min(backoff * 2, max_backoff_s)
 
 
 # ---------------------------------------------------------------------------
@@ -310,8 +376,15 @@ class NotaryServiceFlow(FlowLogic):
             # A consensus window elapsing says NOTHING about the tx: reply
             # with the RETRYABLE unavailability error, never "transaction
             # invalid" (which would mislead a client into abandoning a
-            # perfectly good transaction).
-            raise NotaryException(NotaryUnavailable(str(e))) from e
+            # perfectly good transaction). Attach the provider's current
+            # leader hint so the client's retry can go straight there.
+            hint = getattr(provider, "leader_hint", None)
+            if callable(hint):
+                try:
+                    hint = hint()
+                except Exception:
+                    hint = None
+            raise NotaryException(NotaryUnavailable(str(e), hint)) from e
 
 
 @register_flow

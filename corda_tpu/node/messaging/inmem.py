@@ -28,6 +28,7 @@ from .api import (
     TopicSession,
     fresh_message_id,
 )
+from ...testing import faults as _faults
 
 
 @dataclass(frozen=True, order=True)
@@ -118,10 +119,29 @@ class InMemoryMessagingNetwork:
         self.sent_messages.append(record)
         for obs in list(self._send_observers):
             obs(record)
+        duplicate = False
+        if _faults.ACTIVE is not None:
+            act = _faults.ACTIVE.fire("transport.send")
+            if act is not None:
+                action, delay_s = act
+                if action == "drop":
+                    return
+                if action in ("delay", "reorder"):
+                    # delay_s counts in ticks on the in-memory network;
+                    # reorder defaults to 2 ticks so same-tick traffic
+                    # overtakes this message.
+                    delay += max(1, int(delay_s)) if delay_s else 2
+                elif action == "duplicate":
+                    duplicate = True
         heapq.heappush(
             self._in_flight, (self._tick + delay, self._seq, recipient, message)
         )
         self._seq += 1
+        if duplicate:
+            heapq.heappush(
+                self._in_flight, (self._tick + delay, self._seq, recipient, message)
+            )
+            self._seq += 1
 
     def pump(self) -> bool:
         """Deliver the next in-flight message; returns False when idle.
@@ -133,6 +153,18 @@ class InMemoryMessagingNetwork:
             if endpoint is None or not endpoint.running:
                 self._durable.setdefault(recipient, deque()).append(message)
                 continue
+            if _faults.ACTIVE is not None:
+                act = _faults.ACTIVE.fire("transport.recv")
+                if act is not None:
+                    action, delay_s = act
+                    if action == "drop":
+                        continue
+                    if action == "delay":
+                        heapq.heappush(self._in_flight, (
+                            self._tick + max(1, int(delay_s)),
+                            self._seq, recipient, message))
+                        self._seq += 1
+                        continue
             endpoint._deliver(message)
             return True
         return False

@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from ...serialization.codec import DeserializationError, deserialize, register, serialize
+from ...testing import faults as _faults
 from .api import (
     DEFAULT_SESSION_ID,
     Message,
@@ -320,6 +321,12 @@ class TcpMessaging(MessagingService):
 
     RETRY_BACKOFF = (0.05, 0.1, 0.2, 0.5, 1.0)  # then every 1s
     POISON_RETRIES = 50  # failed deliveries before a message is dropped
+    # A frame written to a live connection but un-ACKed for this long is
+    # assumed lost (e.g. the receiver dropped it without acking while other
+    # traffic keeps the connection busy): reconnect and resend. Without
+    # this, a lost frame on a busy connection only redelivered after a
+    # reconnect that steady ACK traffic never triggers.
+    STALE_RESEND_S = 5.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, db=None,
                  tls: dict | None = None):
@@ -365,6 +372,12 @@ class TcpMessaging(MessagingService):
         self._deferred_bridge_peers: set[str] = set()
         # Bridge writev accounting (see transport_stats).
         self._flush_stats = {"flushes": 0, "frames": 0, "max_frames": 0}
+        # Redelivery accounting (see transport_stats): frames the dedupe
+        # layer absorbed (sender resent something we already processed),
+        # and poison messages dropped at the retry cap.
+        self._redeliveries = 0
+        self._poison_drops = 0
+        self._stale_resends = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -423,12 +436,32 @@ class TcpMessaging(MessagingService):
         )).bytes
         peer = str(to)
         self._outbox.append(peer, unique_id, frame)
+        if _faults.ACTIVE is not None and self._fault_send(peer, unique_id, frame):
+            return
         if self._db is not None and self._db.in_batch:
             # The row isn't committed yet; bridges read via the aux
             # connection and would see nothing. Wake them after the round.
             self._deferred_bridge_peers.add(peer)
         else:
             self._ensure_bridge(peer)
+
+    def _fault_send(self, peer: str, unique_id: bytes, frame: bytes) -> bool:
+        """transport.send injection on the durable path. Returns True when
+        the bridge wakeup should be skipped: the outbox row STAYS, so a
+        "dropped" or "delayed" frame is redelivered by the bridge's ~1s
+        fallback re-poll — this models wire loss with the durable layer
+        recovering, which is exactly the contract under test."""
+        act = _faults.ACTIVE.fire("transport.send")
+        if act is None:
+            return False
+        action, _delay_s = act
+        if action in ("drop", "delay", "reorder"):
+            return True
+        if action == "duplicate" and frame is not None:
+            # Second outbox row, same unique_id: the receiver's dedupe
+            # must absorb it.
+            self._outbox.append(peer, unique_id, frame)
+        return False
 
     def send_many(self, topic_session: TopicSession, datas, to: Any) -> None:
         """Burst send: every payload in `datas` goes to ONE peer through one
@@ -450,6 +483,8 @@ class TcpMessaging(MessagingService):
             )).bytes))
         peer = str(to)
         self._outbox.append_many(peer, entries)
+        if _faults.ACTIVE is not None and self._fault_send(peer, None, None):
+            return  # whole burst "lost"; the fallback re-poll redelivers
         if self._db is not None and self._db.in_batch:
             self._deferred_bridge_peers.add(peer)
         else:
@@ -481,6 +516,13 @@ class TcpMessaging(MessagingService):
             "bridge_max_flush": fl["max_frames"],
             "bridge_flush_avg": (round(fl["frames"] / fl["flushes"], 3)
                                  if fl["flushes"] else None),
+            # Redelivery / retry-cap surfacing: how hard the at-least-once
+            # machinery is working (and whether the poison cap is biting).
+            "redeliveries": self._redeliveries,
+            "stale_resends": self._stale_resends,
+            "poison_pending": len(self._poison),
+            "poison_drops": self._poison_drops,
+            "poison_retry_limit": self.POISON_RETRIES,
         }
 
     def _ensure_bridge(self, peer: str) -> None:
@@ -557,10 +599,22 @@ class TcpMessaging(MessagingService):
         polls touch only new rows; un-ACKed frames from this connection are
         tracked in `sent` and re-sent only after a reconnect."""
         sock.settimeout(0.2)
-        sent: set[bytes] = set()
+        sent: dict[bytes, float] = {}  # unique_id -> monotonic write time
         last_seq = 0
         idle_polls = 0
+        last_stale_check = time.monotonic()
         while self._running:
+            # Stale-resend guard: a frame can be lost AFTER the socket write
+            # (receiver dropped it without acking) while steady ACK traffic
+            # for other frames keeps idle_polls at zero — without this check
+            # such a frame would only redeliver on a reconnect that never
+            # comes. Checked at most once a second.
+            now = time.monotonic()
+            if sent and now - last_stale_check > 1.0:
+                last_stale_check = now
+                if now - min(sent.values()) > self.STALE_RESEND_S:
+                    self._stale_resends += 1
+                    raise OSError("frames un-ACKed past stale-resend window")
             batch = self._outbox.pending_after(peer, last_seq)
             if not batch and not sent:
                 # Clear BEFORE the liveness check: a frame enqueued (and
@@ -589,12 +643,13 @@ class TcpMessaging(MessagingService):
             # a potential segment) per frame.
             buf = bytearray()
             n_frames = 0
+            write_at = time.monotonic()
             for seq, unique_id, frame in batch:
                 if unique_id not in sent:
                     buf += struct.pack(">I", len(frame))
                     buf += frame
                     n_frames += 1
-                    sent.add(unique_id)
+                    sent[unique_id] = write_at
                 last_seq = max(last_seq, seq)
             if buf:
                 sock.sendall(buf)
@@ -611,7 +666,7 @@ class TcpMessaging(MessagingService):
                         and decoded[0] == "ack"
                         and isinstance(decoded[1], bytes)):
                     self._outbox.ack(decoded[1])
-                    sent.discard(decoded[1])
+                    sent.pop(decoded[1], None)
                 elif (isinstance(decoded, tuple) and len(decoded) == 2
                         and decoded[0] == "acks"
                         and isinstance(decoded[1], tuple)):
@@ -619,7 +674,8 @@ class TcpMessaging(MessagingService):
                     # receiver round): retired in one sqlite transaction.
                     ids = [u for u in decoded[1] if isinstance(u, bytes)]
                     self._outbox.ack_many(ids)
-                    sent.difference_update(ids)
+                    for u in ids:
+                        sent.pop(u, None)
                 idle_polls = 0
             except socket.timeout:
                 idle_polls += 1
@@ -783,7 +839,18 @@ class TcpMessaging(MessagingService):
                 n += 1
 
     def _dispatch(self, conn, message: Message) -> bool:
+        if _faults.ACTIVE is not None:
+            act = _faults.ACTIVE.fire("transport.recv")
+            if act is not None:
+                action, delay_s = act
+                if action == "drop":
+                    # No ack, no dedupe record: the sender's stale-resend
+                    # window (STALE_RESEND_S) redelivers it.
+                    return False
+                if action == "delay" and delay_s > 0:
+                    time.sleep(delay_s)  # slow-consumer fault: stalls pump
         if self._dedupe.seen(message.unique_id):
+            self._redeliveries += 1
             self._ack(conn, message.unique_id)  # redelivery: ack, don't re-run
             return False
         handlers = [h for h in self._handlers
@@ -824,6 +891,7 @@ class TcpMessaging(MessagingService):
                 "dropping poison message on %s after %d failed deliveries",
                 message.topic_session, tries)
             self._poison.pop(message.unique_id, None)
+            self._poison_drops += 1
         # Processed (or poison-dropped): record id durably, THEN ack (crash
         # before this point means the sender redelivers; crash after means
         # dedupe swallows it). If SOME handlers succeeded and others failed,

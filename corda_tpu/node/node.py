@@ -583,6 +583,11 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
     config = NodeConfig.load(argv[0])
+    # Chaos harness: CORDA_TPU_FAULT_PLAN=<plan.toml> arms a deterministic
+    # fault plan for this process (per-node rules filter on config.name).
+    from ..testing import faults as _faults
+
+    _faults.arm_from_env(config.name)
     node = Node(config).start()
     print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
     # Attribution hook: CORDA_TPU_NODE_PROFILE=<dir> dumps a cProfile of

@@ -27,6 +27,7 @@ from typing import Any
 from ..crypto.hashes import SecureHash
 from ..flows.api import flow_registry
 from ..serialization.codec import deserialize, register, serialize
+from ..testing import faults as _faults
 from .messaging.api import Message, MessagingService, TopicSession
 
 # Codec-whitelist imports: every type that can cross the RPC boundary must be
@@ -209,6 +210,18 @@ class NodeRpcOps:
             # the per-flow half of the reference's JMX metrics export.
             "flow_timings": {k: dict(v)
                              for k, v in smm.flow_timings.items()},
+            # Armed fault-injection counters (testing/faults.py): fired
+            # "point:action" counts, or None when no plan is armed — lets a
+            # chaos harness audit what a node actually injected.
+            "faults": (_faults.ACTIVE.injected()
+                       if _faults.ACTIVE is not None else None),
+            # Device-tier degrade bookkeeping (crypto/provider.py
+            # degrade_device): demotions and re-probe outcomes.
+            "verify_device_degrades": getattr(smm.verifier, "degraded", None),
+            "verify_device_reprobes_ok": getattr(
+                smm.verifier, "reprobes_ok", None),
+            "verify_device_reprobes_failed": getattr(
+                smm.verifier, "reprobes_failed", None),
         }
 
 

@@ -45,6 +45,7 @@ from typing import Any, Callable, Sequence
 from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
 from ...serialization.codec import deserialize, register, serialize
+from ...testing import faults as _faults
 from ..messaging.api import MessagingService, TopicSession
 from .api import (
     ConsumingTx,
@@ -361,6 +362,8 @@ class RaftMember:
         return None if row is None else row[0]
 
     def _log_append(self, idx: int, term: int, command) -> None:
+        if _faults.ACTIVE is not None:
+            _faults.fire_fsync("raft.fsync")
         blob = serialize(command).bytes
         with self.db.lock:
             self.db.conn.execute(
@@ -374,6 +377,8 @@ class RaftMember:
         """Follower-side append of a pre-encoded entry: the wire blob goes
         into raft_log verbatim (no decode on the replication hot path);
         deserialization happens lazily at apply time."""
+        if _faults.ACTIVE is not None:
+            _faults.fire_fsync("raft.fsync")
         blob = bytes(blob)
         with self.db.lock:
             self.db.conn.execute(
@@ -613,6 +618,20 @@ class RaftMember:
     # -- message handling --------------------------------------------------
 
     def _send(self, to, payload) -> None:
+        if _faults.ACTIVE is not None and isinstance(
+                payload, (AppendEntries, AppendReply)):
+            # raft.append: only the replication stream — votes stay intact
+            # so an armed plan cannot make leader election itself impossible.
+            act = _faults.ACTIVE.fire("raft.append")
+            if act is not None:
+                action, delay_s = act
+                if action == "drop":
+                    return
+                if action in ("delay", "reorder") and delay_s > 0:
+                    _time.sleep(delay_s)
+                elif action == "duplicate":
+                    self.messaging.send(TopicSession(RAFT_TOPIC, 0),
+                                        serialize(payload).bytes, to)
         self.messaging.send(TopicSession(RAFT_TOPIC, 0),
                             serialize(payload).bytes, to)
 
@@ -1162,6 +1181,12 @@ class RaftUniquenessProvider(UniquenessProvider):
         (n,) = self.member.db.conn.execute(
             "SELECT COUNT(*) FROM committed_states").fetchone()
         return n
+
+    def leader_hint(self) -> str | None:
+        """Legal name of the member this replica believes leads the cluster
+        (None during elections) — attached to NotaryUnavailable replies so
+        retrying clients can skip a redirect round trip."""
+        return self.member.leader_name
 
 
 def make_apply_command(db) -> Callable[[PutAllCommand], UniquenessConflict | None]:

@@ -57,6 +57,7 @@ from ..flows.api import (
 )
 from ..serialization.codec import deserialize, register, serialize
 from ..serialization.tokens import TokenContext
+from ..testing import faults as _faults
 from ..utils.excheckpoint import record_exception, rebuild_exception
 from .messaging.api import DEFAULT_SESSION_ID, Message, MessagingService, TopicSession
 
@@ -759,7 +760,10 @@ class StateMachineManager:
                         # buffer at once (the upstream half of the raft
                         # entries_per_batch stamp).
                         "service_polls": 0, "service_completions": 0,
-                        "service_round_max": 0}
+                        "service_round_max": 0,
+                        # Device-verifier failures absorbed by the host
+                        # tier (degrade_device) instead of rejecting flows.
+                        "verify_device_degraded": 0}
         # Per-flow-name timing aggregates (the JMX/Jolokia capability the
         # reference exports per-MBean, reference: Node.kt:313 — here over
         # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
@@ -843,6 +847,8 @@ class StateMachineManager:
         self._write_checkpoint(fsm)
 
     def _write_checkpoint(self, fsm: FlowStateMachine) -> None:
+        if _faults.ACTIVE is not None:
+            _faults.fire_fsync("checkpoint.write")
         self.metrics["checkpointing_rate"] += 1
         blob = self._serialize_checkpoint(fsm)
         self.checkpoint_storage.update_checkpoint(fsm.run_id, blob)
@@ -1130,6 +1136,8 @@ class StateMachineManager:
             self.metrics["verify_batches"] += 1
             self.metrics["verify_sigs"] += len(handle.jobs)
             if handle.error is not None:
+                if self._degrade_and_reverify(handle):
+                    continue
                 for fsm, request, start, end in handle.context:
                     if fsm.state != _WAIT_VERIFY:
                         continue
@@ -1139,6 +1147,34 @@ class StateMachineManager:
         if done:
             self._pump()
         return done
+
+    def _degrade_and_reverify(self, handle) -> bool:
+        """A raised verify on a DEVICE-backed verifier must not reject the
+        waiting flows — an infrastructure fault is not a bad signature.
+        Demote the device tier (crypto.provider.degrade_device installs the
+        gate + cooldown re-probe) and re-verify this batch synchronously on
+        the host tier, which has the same accept set. Returns True when the
+        batch was delivered that way; False (verifier has no device tier,
+        or the host re-verify itself raised) falls back to rejection."""
+        verifier = getattr(self.async_verify, "verifier", None)
+        if verifier is None or getattr(verifier, "device_min_sigs", None) is None:
+            return False
+        from ..crypto.provider import degrade_device, host_verify
+
+        try:
+            degrade_device(verifier)
+            ok = host_verify(handle.jobs)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "host re-verify after device degrade failed")
+            return False
+        self.metrics["verify_device_degraded"] += 1
+        logging.getLogger(__name__).warning(
+            "device verify failed (%s); batch of %d re-verified on host, "
+            "device tier degraded pending re-probe",
+            handle.error, len(handle.jobs))
+        self._deliver_verify_results(handle.context, ok)
+        return True
 
     # -- messaging ---------------------------------------------------------
 

@@ -1,29 +1,66 @@
 """Test infrastructure: dummy contracts, canned identities, mock services,
-deterministic in-memory network (MockNetwork), ledger DSL."""
+deterministic in-memory network (MockNetwork), ledger DSL, fault injection.
 
-from .dummies import (  # noqa: F401
-    DummyContract,
-    DummySingleOwnerState,
-    DummyMultiOwnerState,
-    DUMMY_PROGRAM_ID,
-    DummyCreate,
-    DummyMove,
-)
-from .identities import (  # noqa: F401
-    ALICE,
-    ALICE_KEY,
-    BOB,
-    BOB_KEY,
-    CHARLIE,
-    CHARLIE_KEY,
-    DUMMY_NOTARY,
-    DUMMY_NOTARY_KEY,
-    MEGA_CORP,
-    MEGA_CORP_KEY,
-    MINI_CORP,
-    MINI_CORP_KEY,
-)
-from .mock_network import MockNetwork, MockNode  # noqa: F401
-from .ledger_dsl import ledger  # noqa: F401
-from .expect import expect, expect_events, parallel, sequence  # noqa: F401
-from .simulation import Simulation, TradeSimulation  # noqa: F401
+Submodules are loaded lazily (PEP 562).  Production modules (the TCP
+transport, Raft, the state machine) import ``corda_tpu.testing.faults``
+for their injection hooks; an eager ``from .mock_network import ...``
+here would drag ``node.messaging`` back in and create an import cycle.
+"""
+
+_EXPORTS = {
+    # dummies
+    "DummyContract": "dummies",
+    "DummySingleOwnerState": "dummies",
+    "DummyMultiOwnerState": "dummies",
+    "DUMMY_PROGRAM_ID": "dummies",
+    "DummyCreate": "dummies",
+    "DummyMove": "dummies",
+    # identities
+    "ALICE": "identities",
+    "ALICE_KEY": "identities",
+    "BOB": "identities",
+    "BOB_KEY": "identities",
+    "CHARLIE": "identities",
+    "CHARLIE_KEY": "identities",
+    "DUMMY_NOTARY": "identities",
+    "DUMMY_NOTARY_KEY": "identities",
+    "MEGA_CORP": "identities",
+    "MEGA_CORP_KEY": "identities",
+    "MINI_CORP": "identities",
+    "MINI_CORP_KEY": "identities",
+    # mock network
+    "MockNetwork": "mock_network",
+    "MockNode": "mock_network",
+    # ledger DSL / expectations / simulation
+    "ledger": "ledger_dsl",
+    "expect": "expect",
+    "expect_events": "expect",
+    "parallel": "expect",
+    "sequence": "expect",
+    "Simulation": "simulation",
+    "TradeSimulation": "simulation",
+}
+
+_SUBMODULES = {"dummies", "identities", "mock_network", "ledger_dsl",
+               "expect", "simulation", "faults", "driver", "generators"}
+
+__all__ = sorted(_EXPORTS) + sorted(_SUBMODULES)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        module = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    if name in _SUBMODULES:
+        module = importlib.import_module(f".{name}", __name__)
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

@@ -298,7 +298,11 @@ class Driver:
                    raft_cluster: tuple[str, ...] = (),
                    wait: bool = True, extra_toml: str = "",
                    device: str = "cpu",
+                   env_extra: dict | None = None,
                    host: Host | None = None) -> NodeProcess:
+        """env_extra: extra environment for the child (e.g.
+        CORDA_TPU_FAULT_PLAN=<plan.toml> to arm a chaos plan in that
+        process without touching node.toml)."""
         host = host or self.host
         node_dir = self.base_dir / name
         host.mkdir(node_dir)
@@ -309,9 +313,12 @@ class Driver:
             raft_cluster=raft_cluster, cordapps=cordapps,
             extra_toml=extra_toml, rpc_users=rpc_users))
 
+        env = _node_env(device)
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
         process = host.spawn(
             self._NODE_ARGV + [str(config_path)],
-            node_dir / "node.log", self._NODE_CWD, _node_env(device))
+            node_dir / "node.log", self._NODE_CWD, env)
         handle = NodeProcess(name, node_dir, config_path, process,
                              rpc_users=rpc_users, device=device, host=host)
         self.nodes.append(handle)

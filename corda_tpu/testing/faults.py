@@ -1,0 +1,273 @@
+"""Deterministic fault injection for the notary pipeline.
+
+A process arms at most one :class:`FaultPlan`.  Hooks compiled into the
+transport, Raft, verifier, and checkpoint layers consult the module-level
+``ACTIVE`` plan; when no plan is armed the hook is a single attribute
+check (``faults.ACTIVE is not None``), so the hot path pays nothing
+measurable.
+
+Injection points
+----------------
+
+==================  =============================================  =======================================
+point               fired from                                     actions
+==================  =============================================  =======================================
+``transport.send``  inmem ``_transmit`` / tcp ``send``/``send_many``  drop, delay, duplicate, reorder, crash
+``transport.recv``  inmem ``pump`` / tcp ``_dispatch``             drop, delay, crash
+``raft.append``     RaftMember ``_send`` (append traffic)          drop, delay, duplicate, crash
+``raft.fsync``      RaftMember log append (sqlite insert+commit)   fail, stall, crash
+``verify.device``   AsyncVerifyService feeder thread               fail, slow, crash
+``checkpoint.write`` SMM ``_write_checkpoint``                     fail, stall, crash
+==================  =============================================  =======================================
+
+Determinism: every rule owns a ``random.Random`` seeded from
+``(plan seed, point, rule index)``, and probability draws consume that
+stream one draw per *event at that point*.  Two plans built from the same
+seed and rule list therefore produce the same fault schedule regardless
+of how events at different points interleave.
+
+TOML plan format (see ``plan_from_toml``)::
+
+    seed = 7
+
+    [[rule]]
+    point = "transport.send"
+    action = "drop"
+    p = 0.05           # fire probability per event (default 1.0)
+    delay_s = 0.0      # delay/stall/slow duration (inmem: ticks)
+    after = 0          # skip the first N events at this point
+    max_fires = 100    # stop firing after N fires (0 = unlimited)
+    node = "Raft1"     # only armed on this node (default: all)
+
+Arming across OS processes: export ``CORDA_TPU_FAULT_PLAN=/path/plan.toml``
+before starting a node; ``corda_tpu.node.node.main`` calls
+:func:`arm_from_env` with the node's name so per-node rules filter
+correctly.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "POINTS",
+    "FaultRule",
+    "FaultPlan",
+    "ACTIVE",
+    "arm",
+    "disarm",
+    "injected",
+    "fire",
+    "fire_fsync",
+    "plan_from_toml",
+    "arm_from_env",
+    "builtin_plan",
+    "PLAN_ENV",
+]
+
+POINTS = (
+    "transport.send",
+    "transport.recv",
+    "raft.append",
+    "raft.fsync",
+    "verify.device",
+    "checkpoint.write",
+)
+
+# Exit code used by the "crash" action so harnesses can tell an injected
+# crash from a genuine one.
+CRASH_EXIT_CODE = 70
+
+PLAN_ENV = "CORDA_TPU_FAULT_PLAN"
+
+
+@dataclass
+class FaultRule:
+    """One named fault at one injection point."""
+
+    point: str
+    action: str           # drop | delay | duplicate | reorder | fail | stall | slow | crash
+    p: float = 1.0        # fire probability per event
+    delay_s: float = 0.0  # delay/stall/slow duration (ticks for inmem)
+    after: int = 0        # skip the first N events at this point
+    max_fires: int = 0    # 0 = unlimited
+    node: str | None = None  # restrict to one node name
+
+    # runtime state (not part of the plan identity)
+    fires: int = field(default=0, compare=False)
+    _rng: random.Random = field(default=None, compare=False, repr=False)
+
+    def exhausted(self) -> bool:
+        return self.max_fires > 0 and self.fires >= self.max_fires
+
+
+class FaultPlan:
+    """A seeded set of fault rules, armed process-wide via :func:`arm`.
+
+    ``node_name`` filters rules with a ``node=`` restriction at
+    construction time; filtering never perturbs the per-rule RNG streams
+    because each rule is seeded from its index in the *original* rule
+    list.
+    """
+
+    def __init__(self, seed: int, rules: list[FaultRule],
+                 node_name: str | None = None):
+        self.seed = int(seed)
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        # event counter per point (all events, fired or not)
+        self.events: dict[str, int] = {}
+        # fired counter per "point:action"
+        self.counters: dict[str, int] = {}
+        armed = []
+        for idx, rule in enumerate(rules):
+            if rule.point not in POINTS:
+                raise ValueError(f"unknown injection point {rule.point!r}")
+            rule._rng = random.Random(f"{self.seed}:{rule.point}:{idx}")
+            rule.fires = 0
+            if rule.node is not None and node_name is not None \
+                    and rule.node != node_name:
+                continue
+            armed.append(rule)
+        self.rules = armed
+        self._by_point: dict[str, list[FaultRule]] = {}
+        for rule in self.rules:
+            self._by_point.setdefault(rule.point, []).append(rule)
+
+    def fire(self, point: str) -> tuple[str, float] | None:
+        """Record one event at *point*; return ``(action, delay_s)`` when a
+        rule fires, else ``None``.  The ``crash`` action never returns."""
+        rules = self._by_point.get(point)
+        with self._lock:
+            self.events[point] = self.events.get(point, 0) + 1
+            seen = self.events[point]
+            if not rules:
+                return None
+            for rule in rules:
+                if rule.exhausted() or seen <= rule.after:
+                    continue
+                # one draw per event keeps the schedule independent of
+                # which earlier rules fired
+                if rule.p < 1.0 and rule._rng.random() >= rule.p:
+                    continue
+                rule.fires += 1
+                key = f"{point}:{rule.action}"
+                self.counters[key] = self.counters.get(key, 0) + 1
+                if rule.action == "crash":
+                    os._exit(CRASH_EXIT_CODE)
+                return rule.action, rule.delay_s
+        return None
+
+    def injected(self) -> dict[str, int]:
+        """Copy of the fired counters (``point:action`` -> count)."""
+        with self._lock:
+            return dict(self.counters)
+
+    def event_counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.events)
+
+
+# The armed plan.  Hooks read this exactly once per event:
+#   if faults.ACTIVE is not None: ...
+ACTIVE: FaultPlan | None = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    global ACTIVE
+    ACTIVE = plan
+    return plan
+
+
+def disarm() -> None:
+    global ACTIVE
+    ACTIVE = None
+
+
+def injected() -> dict[str, int]:
+    """Fired counters of the armed plan (empty dict when disarmed)."""
+    plan = ACTIVE
+    return plan.injected() if plan is not None else {}
+
+
+def fire(point: str) -> tuple[str, float] | None:
+    """Convenience: fire *point* against the armed plan, if any."""
+    plan = ACTIVE
+    return plan.fire(point) if plan is not None else None
+
+
+def fire_fsync(point: str) -> None:
+    """Shared hook body for durability points (``raft.fsync``,
+    ``checkpoint.write``): ``stall`` sleeps, ``fail`` raises OSError."""
+    plan = ACTIVE
+    if plan is None:
+        return
+    act = plan.fire(point)
+    if act is None:
+        return
+    action, delay_s = act
+    if action == "stall" and delay_s > 0:
+        time.sleep(delay_s)
+    elif action in ("fail", "raise"):
+        raise OSError(f"fault injected: {point} failure")
+
+
+def plan_from_toml(text: str, node_name: str | None = None) -> FaultPlan:
+    """Parse a TOML plan (see module docstring for the format)."""
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python < 3.11
+        import tomli as tomllib
+
+    data = tomllib.loads(text)
+    seed = int(data.get("seed", 0))
+    rules = []
+    for raw in data.get("rule", []):
+        rules.append(FaultRule(
+            point=raw["point"],
+            action=raw["action"],
+            p=float(raw.get("p", 1.0)),
+            delay_s=float(raw.get("delay_s", 0.0)),
+            after=int(raw.get("after", 0)),
+            max_fires=int(raw.get("max_fires", 0)),
+            node=raw.get("node"),
+        ))
+    return FaultPlan(seed, rules, node_name=node_name)
+
+
+def arm_from_env(node_name: str | None = None) -> FaultPlan | None:
+    """Arm from ``$CORDA_TPU_FAULT_PLAN`` (a TOML path) if set.
+
+    Called by ``corda_tpu.node.node.main`` so child processes spawned by
+    the driver/loadtest pick up the plan without config changes."""
+    path = os.environ.get(PLAN_ENV)
+    if not path:
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    return arm(plan_from_toml(text, node_name=node_name))
+
+
+def builtin_plan(name: str, node_name: str | None = None) -> FaultPlan:
+    """Named plans for the chaos loadtest / bench (``lossy``, ``slow-disk``,
+    ``flaky-device``)."""
+    if name == "lossy":
+        # ~5% send-side loss; durable outbox re-poll recovers each loss
+        # within ~1s, so the run completes with elevated tail latency.
+        return FaultPlan(7, [
+            FaultRule("transport.send", "drop", p=0.05, max_fires=500),
+        ], node_name=node_name)
+    if name == "slow-disk":
+        return FaultPlan(11, [
+            FaultRule("raft.fsync", "stall", p=0.10, delay_s=0.05,
+                      max_fires=200),
+        ], node_name=node_name)
+    if name == "flaky-device":
+        return FaultPlan(13, [
+            FaultRule("verify.device", "fail", p=1.0, max_fires=1),
+        ], node_name=node_name)
+    raise ValueError(f"unknown builtin fault plan {name!r}")

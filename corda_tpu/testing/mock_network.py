@@ -210,14 +210,25 @@ class MockNetwork:
 
     def run_network(self, max_messages: int = 100_000) -> int:
         """Pump until quiescent: drain all in-flight messages, then flush
-        every node's accumulated verify micro-batch, repeat. Message drains
-        between flushes are what make the batches wide."""
+        every node's accumulated verify micro-batch, poll parked
+        ServiceRequests (async providers, retry-backoff timers), repeat.
+        Message drains between flushes are what make the batches wide."""
+        import time as _time
+
         delivered = 0
         while True:
             delivered += self.messaging_network.run(max_messages)
             flushed = sum(node.smm.flush_pending_verifies() for node in self.nodes)
-            if flushed == 0 and self.messaging_network.in_flight_count == 0:
+            polled = sum(node.smm.poll_services() for node in self.nodes)
+            parked = sum(len(node.smm._service_queue) for node in self.nodes)
+            if (flushed == 0 and polled == 0 and parked == 0
+                    and self.messaging_network.in_flight_count == 0):
                 return delivered
+            if (parked and not flushed and not polled
+                    and self.messaging_network.in_flight_count == 0):
+                # Everything quiescent except a pending service poll (e.g.
+                # a retry-backoff timer): wait it out without spinning hot.
+                _time.sleep(0.005)
 
     def stop_nodes(self) -> None:
         for node in self.nodes:

@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from ..flows.api import FlowLogic, register_flow
 from ..flows.notary import NotaryClientFlow
 from ..node.config import BatchConfig, NodeConfig
 from ..node.node import Node
@@ -188,6 +189,233 @@ def run_loadtest(
     for n in nodes:
         n.stop()
     return result
+
+
+@register_flow
+class RetryingNotariseFlow(FlowLogic):
+    """Chaos-harness client flow: notarise with the PRODUCT retry policy
+    (deadline-bounded, exponential backoff, leader-hint redirects) so an
+    availability window — a killed leader, an election — is ridden out
+    instead of reported as a failure. The plain loadtest keeps calling
+    NotaryClientFlow raw; this flow exists to measure recovery, not to
+    mask unavailability."""
+
+    def __init__(self, stx, deadline_s: float = 60.0):
+        self.stx = stx
+        self.deadline_s = deadline_s
+
+    def call(self):
+        from ..flows.notary import notarise_with_retry
+
+        sig = yield from notarise_with_retry(
+            self, self.stx, deadline_s=self.deadline_s)
+        return sig
+
+
+@dataclass
+class ChaosResult:
+    """One chaos loadtest run: outcome audit + measured recovery."""
+
+    plan: str | None
+    tx_requested: int
+    tx_committed: int
+    tx_rejected: int
+    tx_unresolved: int  # flows that never completed (MUST be 0)
+    exactly_once: bool  # committed==requested, none rejected/lost/doubled
+    cluster_committed: int  # committed_states rows on the leader
+    duration_s: float
+    tx_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    faults_injected: dict = field(default_factory=dict)
+    leader_kill_recovery_s: float | None = None
+    disruptions: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+def run_chaos_loadtest(
+    plan=None,  # FaultPlan | builtin name | path to a plan TOML | None
+    n_tx: int = 60,
+    cluster_size: int = 3,
+    kill_leader: bool = False,
+    verifier: str = "cpu",
+    batch: BatchConfig | None = None,
+    base_dir: str | None = None,
+    max_seconds: float = 180.0,
+    rate_tx_s: float = 0.0,  # >0: open-loop pacing, latency from schedule
+    retry_deadline_s: float = 60.0,
+) -> ChaosResult:
+    """Chaos mode: an in-process raft cluster + client over REAL TCP and
+    sqlite, with a deterministic FaultPlan armed process-wide and/or the
+    LEADER killed mid-burst and rebuilt from disk. Clients notarise through
+    RetryingNotariseFlow (the product retry policy), so the run audits the
+    end-to-end exactly-once contract: every tx committed exactly once, none
+    lost, none rejected, no input double-spent — and measures recovery
+    (first completion after the kill) plus tail latency under faults.
+
+    In-process runs share ONE plan across client and members; `crash`
+    actions would kill the whole harness — use process-level kill_leader
+    (or the driver's env_extra arming) for crash faults."""
+    from ..testing import faults
+
+    plan_obj = None
+    if plan is not None:
+        if isinstance(plan, faults.FaultPlan):
+            plan_obj = plan
+        elif isinstance(plan, (str, Path)):
+            text = None
+            p = Path(plan)
+            if p.suffix == ".toml" or p.exists():
+                text = p.read_text(encoding="utf-8")
+            if text is not None:
+                plan_obj = faults.plan_from_toml(text)
+            else:
+                plan_obj = faults.builtin_plan(str(plan))
+        else:
+            raise TypeError(f"plan: expected FaultPlan/str/Path, got {plan!r}")
+
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-chaos-"))
+    batch = batch or BatchConfig()
+    cluster = tuple(f"Raft{i}" for i in range(cluster_size))
+    disruptions: list[str] = []
+    notaries: list[Node] = []
+    if plan_obj is not None:
+        faults.arm(plan_obj)
+    try:
+        for name in cluster:
+            notaries.append(_make_node(
+                base, name, notary="raft-simple", raft_cluster=cluster,
+                verifier=verifier, batch=batch))
+        client = _make_node(base, "ChaosClient", verifier=verifier,
+                            batch=batch)
+        nodes = notaries + [client]
+        for n in nodes:
+            n.refresh_netmap()
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            for n in nodes:
+                n.run_once(timeout=0.005)
+            if any(n.raft_member.role == "leader" for n in notaries):
+                break
+        else:
+            raise RuntimeError("raft cluster failed to elect")
+
+        target = notaries[0].identity
+        stxs = []
+        for i in range(n_tx):
+            builder = DummyContract.generate_initial(
+                client.identity.ref(i.to_bytes(4, "big")), i, target)
+            builder.sign_with(client.key)
+            issue_stx = builder.to_signed_transaction()
+            client.services.record_transactions([issue_stx])
+            move = DummyContract.move(issue_stx.tx.out_ref(0),
+                                      client.identity.owning_key)
+            move.sign_with(client.key)
+            stxs.append(move.to_signed_transaction(
+                check_sufficient_signatures=False))
+
+        t0 = time.perf_counter()
+        completions: list[float] = []  # completion times since t0
+        lat: list[float] = []  # per-tx latency (from schedule when paced)
+        handles = []
+        submitted = 0
+        killed_at: float | None = None
+        run_deadline = time.monotonic() + max_seconds
+        while time.monotonic() < run_deadline:
+            now = time.perf_counter() - t0
+            while submitted < n_tx and (
+                    rate_tx_s <= 0 or now >= submitted / rate_tx_s):
+                sched = submitted / rate_tx_s if rate_tx_s > 0 else 0.0
+                h = client.start_flow(RetryingNotariseFlow(
+                    stxs[submitted], retry_deadline_s))
+
+                def _done(_f, sched=sched):
+                    t = time.perf_counter() - t0
+                    completions.append(t)
+                    lat.append(t - sched)
+
+                h.result.add_done_callback(_done)
+                handles.append(h)
+                submitted += 1
+                if rate_tx_s > 0:
+                    now = time.perf_counter() - t0
+            for n in nodes:
+                n.run_once(timeout=0.002)
+            completed = sum(1 for h in handles if h.result.done)
+            if (kill_leader and killed_at is None
+                    and completed >= max(1, n_tx // 3)):
+                victim = next(
+                    (n for n in notaries if n.raft_member.role == "leader"),
+                    None)
+                if victim is not None:
+                    cfg = victim.config
+                    victim.stop()
+                    nodes.remove(victim)
+                    notaries.remove(victim)
+                    killed_at = time.perf_counter() - t0
+                    disruptions.append(
+                        f"killed leader {cfg.name} after {completed} tx")
+                    reborn = _rebuild(cfg)
+                    notaries.append(reborn)
+                    nodes.append(reborn)
+                    for n in nodes:
+                        n.refresh_netmap()
+                    disruptions.append(f"rebuilt {cfg.name} from disk")
+            if submitted == n_tx and completed == n_tx:
+                break
+        duration = time.perf_counter() - t0
+
+        committed = rejected = unresolved = 0
+        for h in handles:
+            if not h.result.done:
+                unresolved += 1
+            elif h.result.exception() is None:
+                committed += 1
+            else:
+                rejected += 1
+        unresolved += n_tx - submitted
+        # Cluster-side audit: each move spends ONE unique state, so the
+        # leader's committed_states table must hold exactly n_tx rows —
+        # fewer means lost commits, more means a double-spend got through.
+        cluster_committed = max(
+            (n.uniqueness_provider.committed_count for n in notaries
+             if getattr(n, "uniqueness_provider", None) is not None),
+            default=0)
+        recovery = None
+        if killed_at is not None:
+            after = [t for t in completions if t > killed_at]
+            recovery = round(min(after) - killed_at, 3) if after else None
+        srt = sorted(lat) or [0.0]
+        result = ChaosResult(
+            plan=(getattr(plan, "name", None) or str(plan)
+                  if not isinstance(plan, faults.FaultPlan) else "custom")
+                 if plan is not None else None,
+            tx_requested=n_tx,
+            tx_committed=committed,
+            tx_rejected=rejected,
+            tx_unresolved=unresolved,
+            exactly_once=(committed == n_tx and rejected == 0
+                          and unresolved == 0
+                          and cluster_committed == n_tx),
+            cluster_committed=cluster_committed,
+            duration_s=round(duration, 3),
+            tx_per_sec=round(committed / duration, 1) if duration else 0.0,
+            p50_ms=round(1e3 * srt[len(srt) // 2], 2),
+            p99_ms=round(1e3 * srt[min(len(srt) - 1,
+                                       int(len(srt) * 0.99))], 2),
+            faults_injected=(plan_obj.injected() if plan_obj is not None
+                             else faults.injected()),
+            leader_kill_recovery_s=recovery,
+            disruptions=disruptions,
+        )
+        for n in nodes:
+            n.stop()
+        return result
+    finally:
+        if plan_obj is not None:
+            faults.disarm()
 
 
 @dataclass
@@ -622,8 +850,22 @@ def main(argv=None) -> int:
     ap.add_argument("--rate", type=float, default=0.0,
                     help="open-loop offered load per client (tx/s); 0 = "
                          "closed loop")
+    ap.add_argument("--chaos", default=None, metavar="PLAN",
+                    help="chaos mode: arm a fault plan (lossy | slow-disk | "
+                         "flaky-device | path to a plan TOML) and notarise "
+                         "through the retrying client flow")
+    ap.add_argument("--kill-leader", action="store_true",
+                    help="chaos mode: kill the raft LEADER mid-burst and "
+                         "measure recovery (implies chaos mode)")
     args = ap.parse_args(argv)
-    if args.processes:
+    if args.chaos is not None or args.kill_leader:
+        result = run_chaos_loadtest(
+            plan=args.chaos, n_tx=args.tx, cluster_size=args.cluster_size,
+            kill_leader=args.kill_leader, verifier=args.verifier,
+            batch=BatchConfig(max_sigs=args.max_sigs,
+                              max_wait_ms=args.max_wait_ms),
+            rate_tx_s=args.rate)
+    elif args.processes:
         result = run_loadtest_multiprocess(
             n_tx=args.tx, width=args.width, clients=args.clients,
             notary=args.notary, cluster_size=args.cluster_size,

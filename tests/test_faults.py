@@ -1,0 +1,243 @@
+"""Fault-injection engine contract (corda_tpu.testing.faults).
+
+Tier-1 smoke tier for the chaos harness: the engine is deterministic under
+a seed, each injection point actually fires through its wired hook, and a
+disarmed process pays no semantic change. The end-to-end chaos soaks live
+in test_chaos_recovery.py.
+"""
+
+import threading
+
+import pytest
+
+from corda_tpu.testing import faults
+from corda_tpu.testing.faults import FaultPlan, FaultRule
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process disarmed — the plan is module-global
+    and a leak would inject faults into unrelated tests."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Engine semantics
+# ---------------------------------------------------------------------------
+
+
+def _schedule(plan: FaultPlan, point: str, n: int) -> list:
+    return [plan.fire(point) for _ in range(n)]
+
+
+def test_same_seed_same_schedule():
+    mk = lambda: FaultPlan(42, [  # noqa: E731
+        FaultRule("transport.send", "drop", p=0.3),
+        FaultRule("raft.append", "delay", p=0.5, delay_s=0.01),
+    ])
+    a, b = mk(), mk()
+    assert _schedule(a, "transport.send", 50) == \
+        _schedule(b, "transport.send", 50)
+    assert _schedule(a, "raft.append", 50) == _schedule(b, "raft.append", 50)
+    assert a.injected() == b.injected()
+    assert any(v for v in a.injected().values()), "p=0.3/0.5 never fired"
+
+
+def test_different_seed_different_schedule():
+    a = FaultPlan(1, [FaultRule("transport.send", "drop", p=0.5)])
+    b = FaultPlan(2, [FaultRule("transport.send", "drop", p=0.5)])
+    assert _schedule(a, "transport.send", 100) != \
+        _schedule(b, "transport.send", 100)
+
+
+def test_node_filter_does_not_perturb_schedule():
+    """Dropping another node's rules must not shift the surviving rules'
+    RNG streams (rules are seeded by original index, not surviving index)."""
+    rules = lambda: [  # noqa: E731
+        FaultRule("raft.fsync", "stall", p=0.4, node="Raft0"),
+        FaultRule("transport.send", "drop", p=0.4, node="Raft1"),
+    ]
+    both = FaultPlan(9, rules())
+    only1 = FaultPlan(9, rules(), node_name="Raft1")
+    assert len(only1.rules) == 1  # Raft0's fsync rule filtered out
+    assert _schedule(both, "transport.send", 40) == \
+        _schedule(only1, "transport.send", 40)
+
+
+def test_after_and_max_fires_bound_the_rule():
+    plan = FaultPlan(0, [
+        FaultRule("transport.recv", "drop", after=3, max_fires=2)])
+    acts = _schedule(plan, "transport.recv", 10)
+    assert acts == [None, None, None, ("drop", 0.0), ("drop", 0.0),
+                    None, None, None, None, None]
+    assert plan.injected() == {"transport.recv:drop": 2}
+    assert plan.event_counts() == {"transport.recv": 10}
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(ValueError):
+        FaultPlan(0, [FaultRule("transport.teleport", "drop")])
+
+
+def test_disarmed_module_hooks_are_noops():
+    assert faults.ACTIVE is None
+    assert faults.fire("transport.send") is None
+    assert faults.injected() == {}
+    faults.fire_fsync("raft.fsync")  # must not raise
+
+
+def test_fire_fsync_fail_raises_and_stall_sleeps():
+    faults.arm(FaultPlan(0, [FaultRule("raft.fsync", "fail")]))
+    with pytest.raises(OSError):
+        faults.fire_fsync("raft.fsync")
+    faults.arm(FaultPlan(0, [
+        FaultRule("checkpoint.write", "stall", delay_s=0.001)]))
+    faults.fire_fsync("checkpoint.write")  # stall returns after sleeping
+    assert faults.injected() == {"checkpoint.write:stall": 1}
+
+
+def test_plan_from_toml():
+    plan = faults.plan_from_toml(
+        """
+        seed = 21
+
+        [[rule]]
+        point = "transport.send"
+        action = "drop"
+        p = 0.25
+        max_fires = 10
+
+        [[rule]]
+        point = "verify.device"
+        action = "fail"
+        node = "Raft2"
+        """,
+        node_name="Raft0")
+    assert plan.seed == 21
+    assert len(plan.rules) == 1  # Raft2's rule filtered for Raft0
+    r = plan.rules[0]
+    assert (r.point, r.action, r.p, r.max_fires) == \
+        ("transport.send", "drop", 0.25, 10)
+
+
+def test_builtin_plans():
+    for name in ("lossy", "slow-disk", "flaky-device"):
+        plan = faults.builtin_plan(name)
+        assert plan.rules
+    with pytest.raises(ValueError):
+        faults.builtin_plan("nope")
+
+
+def test_arm_from_env(tmp_path, monkeypatch):
+    path = tmp_path / "plan.toml"
+    path.write_text('seed = 3\n[[rule]]\npoint = "raft.append"\n'
+                    'action = "drop"\n')
+    monkeypatch.delenv(faults.PLAN_ENV, raising=False)
+    assert faults.arm_from_env("N") is None
+    monkeypatch.setenv(faults.PLAN_ENV, str(path))
+    plan = faults.arm_from_env("N")
+    assert plan is faults.ACTIVE
+    assert plan.rules[0].point == "raft.append"
+
+
+def test_fire_is_thread_safe():
+    plan = faults.arm(FaultPlan(0, [
+        FaultRule("transport.send", "drop", p=0.5)]))
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(500):
+                plan.fire("transport.send")
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert plan.event_counts()["transport.send"] == 2000
+
+
+# ---------------------------------------------------------------------------
+# Wired hooks (cheap in-process paths)
+# ---------------------------------------------------------------------------
+
+
+def _inmem_pair():
+    from corda_tpu.node.messaging.inmem import InMemoryMessagingNetwork
+
+    net = InMemoryMessagingNetwork()
+    a = net.create_node_messaging("A")
+    b = net.create_node_messaging("B")
+    got = []
+    b.add_message_handler("t", callback=lambda msg: got.append(msg.data))
+    return net, a, b, got
+
+
+def test_inmem_send_drop_and_duplicate():
+    from corda_tpu.node.messaging.api import TopicSession
+
+    net, a, b, got = _inmem_pair()
+    faults.arm(FaultPlan(0, [FaultRule("transport.send", "drop",
+                                       max_fires=1)]))
+    a.send(TopicSession("t", 0), b"m0", b.my_address)
+    a.send(TopicSession("t", 0), b"m1", b.my_address)
+    net.run()
+    assert got == [b"m1"]
+    assert faults.injected() == {"transport.send:drop": 1}
+
+    got.clear()
+    faults.arm(FaultPlan(0, [FaultRule("transport.send", "duplicate",
+                                       max_fires=1)]))
+    a.send(TopicSession("t", 0), b"m2", b.my_address)
+    net.run()
+    # The duplicate reaches the endpoint twice; at-least-once dedupe by
+    # unique_id absorbs the second copy — exactly-once delivery holds.
+    assert got == [b"m2"]
+    assert faults.injected() == {"transport.send:duplicate": 1}
+
+
+def test_inmem_recv_drop():
+    from corda_tpu.node.messaging.api import TopicSession
+
+    net, a, b, got = _inmem_pair()
+    faults.arm(FaultPlan(0, [FaultRule("transport.recv", "drop",
+                                       max_fires=1)]))
+    a.send(TopicSession("t", 0), b"m0", b.my_address)
+    a.send(TopicSession("t", 0), b"m1", b.my_address)
+    net.run()
+    assert got == [b"m1"]
+    assert faults.injected() == {"transport.recv:drop": 1}
+
+
+def test_async_verify_device_fault_crosses_to_handle():
+    """A verify.device 'fail' surfaces as handle.error after drain — the
+    seam the SMM degrade path consumes."""
+    from corda_tpu.crypto.async_verify import AsyncVerifyService
+    from corda_tpu.crypto.provider import CpuVerifier, VerifyJob
+
+    svc = AsyncVerifyService(CpuVerifier(), depth=2, adaptive=False)
+    faults.arm(FaultPlan(0, [FaultRule("verify.device", "fail",
+                                       max_fires=1)]))
+    jobs = [VerifyJob(bytes(32), bytes(32), bytes(64))]
+    svc.submit(jobs, context="c1")
+    svc.submit(jobs, context="c2")
+    done = []
+    deadline = 100
+    while len(done) < 2 and deadline:
+        done.extend(svc.drain())
+        deadline -= 1
+        if len(done) < 2:
+            import time
+
+            time.sleep(0.01)
+    assert len(done) == 2
+    by_ctx = {h.context: h for h in done}
+    assert isinstance(by_ctx["c1"].error, RuntimeError)
+    assert by_ctx["c2"].error is None and by_ctx["c2"].ok is not None
+    assert svc.close()
