@@ -670,18 +670,32 @@ def bench_raft_open_loop(rates=(30.0, 90.0, 150.0), n_tx=200,
     numbers the flagship config is judged on) — plus the commit-pipeline
     stamps, summarised once under "replication" from the leader's view:
     entries_per_batch, replication RTT, reply-coalesce ratio, and the
-    transport burst sizes (ARCHITECTURE.md "Commit pipeline")."""
+    transport burst sizes (ARCHITECTURE.md "Commit pipeline").
+
+    The sweep runs with the tracing subsystem armed (corda_tpu/obs/) and
+    emits stage_breakdown: p50/p99/mean per notarise stage (queue_wait,
+    verify_wait, device_verify, raft_append, fsync, replication, reply)
+    across every traced transaction — WHERE the p99 lives, not just what
+    it is. stage_sum_over_e2e near 1.0 certifies the stages account for
+    the measured end-to-end latency."""
+    from corda_tpu.obs import collect as obs_collect
     from corda_tpu.tools.loadtest import run_latency_sweep
 
     sweep = run_latency_sweep(rates=rates, n_tx=n_tx, width=4,
                               notary="raft-validating", coalesce_ms=10.0,
-                              verifier=verifier, notary_device=notary_device)
+                              verifier=verifier, notary_device=notary_device,
+                              trace=True)
+    try:
+        breakdown = obs_collect.stage_breakdown(sweep.trace_snapshots)
+    except Exception as e:  # a malformed snapshot costs the breakdown only
+        breakdown = {"error": f"{type(e).__name__}: {e}"}
     return {"harness": "multiprocess-driver", "width": 4, "n_tx": n_tx,
             "notary": "raft-validating", "verifier": verifier,
             "notary_device": notary_device,
             "coalesce_ms": 10.0,
             "node_stamps": sweep.node_stamps,
             "replication": _replication_summary(sweep.node_stamps),
+            "stage_breakdown": breakdown,
             "rates": {
                 f"{rate:g}_tx_s": {
                     "p50_ms": r.p50_ms, "p90_ms": r.p90_ms,

@@ -46,6 +46,7 @@ import time
 from typing import Any, Sequence
 
 from .provider import BatchVerifier, VerifyJob
+from ..obs import trace as _obs
 from ..testing import faults as _faults
 
 
@@ -233,8 +234,34 @@ class AsyncVerifyService:
                 self.failed_batches += 1
             elif self.adaptive is not None:
                 self.adaptive.observe(handle)
+            if _obs.ACTIVE is not None:
+                self._record_batch_spans(handle)
             done.append(handle)
         return done
+
+    def _record_batch_spans(self, handle: VerifyBatchHandle) -> None:
+        """queue_wait + device_verify batch spans, fanned IN: one device
+        batch serves many transactions, so the spans carry every member
+        flow's trace id (attrs["member_traces"]) and the collector
+        attributes the batch's wall time to each of them. The handle's
+        perf_counter durations are re-anchored onto the epoch clock ending
+        at drain time (the skew — the sub-ms the handle sat in the done
+        queue — is noise next to a device batch)."""
+        members = []
+        for ctx in handle.context or ():
+            fsm = ctx[0] if isinstance(ctx, tuple) else ctx
+            tid = getattr(fsm, "trace_id", None)
+            if tid is not None:
+                members.append(tid.hex())
+        if not members:
+            return
+        now = _obs.now()
+        wall = handle.verify_wall_s
+        wait = handle.queue_wait_s
+        attrs = {"member_traces": members, "tier": handle.tier,
+                 "sigs": len(handle.jobs)}
+        _obs.record("queue_wait", now - wall - wait, now - wall, attrs=attrs)
+        _obs.record("device_verify", now - wall, now, attrs=attrs)
 
     def stats(self) -> dict:
         """Pipeline counters for node_metrics / loadtest stamps."""

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 
 from ..crypto.composite import CompositeKey
 from ..crypto.hashes import SecureHash
+from ..obs import trace as _obs
 from ..crypto.keys import DigitalSignature, SignatureError
 from ..crypto.party import Party
 from ..crypto.signed_data import SignedData
@@ -302,6 +303,7 @@ class NotaryServiceFlow(FlowLogic):
 
     def call(self):
         req = yield self.receive(self.other_side, SignRequest)
+        t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
         try:
             request = req.unwrap(self._validate_request)
             stx = request.tx
@@ -328,6 +330,16 @@ class NotaryServiceFlow(FlowLogic):
                 "notary service flow error; replying NotaryTransactionInvalid"
             )
             result = NotaryFailure(NotaryTransactionInvalid())
+        if _obs.ACTIVE is not None:
+            sm = self.state_machine
+            if sm.trace_id is not None and not sm.replaying:
+                # request received -> reply queued, stitched into the
+                # client's trace (the service fsm joined it at SessionInit).
+                # Skipped on checkpoint replay: the live run already
+                # recorded it.
+                _obs.record("notary_process", t0, _obs.now(),
+                            trace_id=sm.trace_id, parent=sm.trace_span,
+                            attrs={"ok": isinstance(result, NotarySuccess)})
         yield self.send(self.other_side, result)
         return None
 

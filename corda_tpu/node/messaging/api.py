@@ -47,6 +47,11 @@ class Message:
     data: bytes
     unique_id: bytes
     sender: Any = None  # transport address of the origin
+    # Tracing context (obs/trace.py): (trace_id, span_id) of the sending
+    # flow, or None when tracing is disarmed / the sender had no context.
+    # Transports stamp it on send only when obs.ACTIVE is armed — the
+    # disabled path never grows the envelope.
+    trace: Any = None
 
 
 class MessageHandlerRegistration:

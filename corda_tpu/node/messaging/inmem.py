@@ -28,6 +28,7 @@ from .api import (
     TopicSession,
     fresh_message_id,
 )
+from ...obs import trace as _obs
 from ...testing import faults as _faults
 
 
@@ -197,19 +198,49 @@ class InMemoryMessaging(MessagingService):
         self._pending: deque[Message] = deque()  # no handler yet — durable queue
         self._seen_ids: set[bytes] = set()
         self.running = True
+        self._sends = 0
+        self._redeliveries = 0  # dedupe hits (at-least-once duplicates)
 
     @property
     def my_address(self) -> InMemoryAddress:
         return self._address
 
     def send(self, topic_session: TopicSession, data: bytes, to: Any) -> None:
+        trace = None
+        if _obs.ACTIVE is not None:
+            trace = _obs.get_context()
         message = Message(
             topic_session=topic_session,
             data=data,
             unique_id=fresh_message_id(),
             sender=self._address,
+            trace=trace,
         )
+        self._sends += 1
         self._network._transmit(self._address, to, message)
+
+    def transport_stats(self) -> dict:
+        """Schema parity with TcpMessaging.transport_stats() so
+        node_metrics["transport"] is homogeneous across the MockNetwork and
+        multiprocess harnesses. Counters with no in-memory analogue (there
+        is no outbox DB, no bridge socket, no poison queue) report zero;
+        redeliveries counts real dedupe hits."""
+        return {
+            "outbox_appends": self._sends,
+            "outbox_bursts": 0,
+            "outbox_burst_frames": 0,
+            "outbox_max_burst": 0,
+            "outbox_burst_avg": 0.0,
+            "bridge_flushes": 0,
+            "bridge_flush_frames": 0,
+            "bridge_max_flush": 0,
+            "bridge_flush_avg": 0.0,
+            "redeliveries": self._redeliveries,
+            "stale_resends": 0,
+            "poison_pending": 0,
+            "poison_drops": 0,
+            "poison_retry_limit": 0,
+        }
 
     def add_message_handler(
         self,
@@ -242,6 +273,7 @@ class InMemoryMessaging(MessagingService):
             return
         if not deduped:
             if message.unique_id in self._seen_ids:
+                self._redeliveries += 1
                 return  # at-least-once dedupe (NodeMessagingClient.kt:102-113)
             self._seen_ids.add(message.unique_id)
         handlers = self._matching(message.topic_session)

@@ -37,6 +37,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from ...obs import trace as _obs
 from ...serialization.codec import DeserializationError, deserialize, register, serialize
 from ...testing import faults as _faults
 from .api import (
@@ -426,14 +427,28 @@ class TcpMessaging(MessagingService):
 
     # -- sending -----------------------------------------------------------
 
+    def _wire_tuple(self, topic_session: TopicSession, unique_id: bytes,
+                    data: bytes) -> tuple:
+        """The "msg" wire tuple. 7 fields normally; when tracing is armed
+        AND the sending thread carries a context, two fields (trace_id,
+        span_id) ride at the end — readers accept both widths, so mixed
+        armed/disarmed clusters interoperate and the disabled path never
+        grows a frame."""
+        base = (
+            "msg", topic_session.topic, topic_session.session_id, unique_id,
+            self.my_address.host, self.my_address.port, data,
+        )
+        if _obs.ACTIVE is not None:
+            ctx = _obs.get_context()
+            if ctx is not None:
+                return base + (ctx[0], ctx[1])
+        return base
+
     def send(self, topic_session: TopicSession, data: bytes, to: Any) -> None:
         if not isinstance(to, TcpAddress):
             raise TypeError(f"TcpMessaging can only send to TcpAddress, got {to!r}")
         unique_id = fresh_message_id()
-        frame = serialize((
-            "msg", topic_session.topic, topic_session.session_id, unique_id,
-            self.my_address.host, self.my_address.port, data,
-        )).bytes
+        frame = serialize(self._wire_tuple(topic_session, unique_id, data)).bytes
         peer = str(to)
         self._outbox.append(peer, unique_id, frame)
         if _faults.ACTIVE is not None and self._fault_send(peer, unique_id, frame):
@@ -477,10 +492,8 @@ class TcpMessaging(MessagingService):
         entries = []
         for data in datas:
             unique_id = fresh_message_id()
-            entries.append((unique_id, serialize((
-                "msg", topic_session.topic, topic_session.session_id,
-                unique_id, self.my_address.host, self.my_address.port, data,
-            )).bytes))
+            entries.append((unique_id, serialize(
+                self._wire_tuple(topic_session, unique_id, data)).bytes))
         peer = str(to)
         self._outbox.append_many(peer, entries)
         if _faults.ACTIVE is not None and self._fault_send(peer, None, None):
@@ -744,7 +757,19 @@ class TcpMessaging(MessagingService):
                     kind = decoded[0]
                     if kind != "msg":
                         continue
-                    _, topic, session_id, unique_id, shost, sport, data = decoded
+                    # 7 fields plain; 9 when the sender had tracing armed
+                    # (trailing trace_id/span_id). Both widths are valid.
+                    if len(decoded) == 9:
+                        (_, topic, session_id, unique_id, shost, sport,
+                         data, w_trace, w_span) = decoded
+                        if not (isinstance(w_trace, bytes)
+                                and isinstance(w_span, bytes)):
+                            continue
+                        trace = (w_trace, w_span)
+                    else:
+                        _, topic, session_id, unique_id, shost, sport, data = \
+                            decoded
+                        trace = None
                     # Field TYPES are part of the wire contract: hostile
                     # well-formed frames with wrong-typed fields must die
                     # here, not on the node's pump thread (dedupe hashes
@@ -765,6 +790,7 @@ class TcpMessaging(MessagingService):
                     data=data,
                     unique_id=unique_id,
                     sender=TcpAddress(shost, sport),
+                    trace=trace,
                 )
                 self._inbound.put((conn, message))
         except (OSError, DeserializationError):

@@ -23,6 +23,7 @@ import logging
 import os
 import sys
 import time
+from collections import deque
 
 from ..flows.data_vending import install_data_vending
 from ..utils.clock import Clock
@@ -136,7 +137,10 @@ class Node:
             my_info=self.info,
         )
 
-        self.metrics_history: list[dict] = []  # see _sample_metrics_maybe
+        # Bounded by construction (see _sample_metrics_maybe): a week-long
+        # soak keeps exactly one hour of samples, never an unbounded list.
+        self.metrics_history: deque[dict] = deque(
+            maxlen=self.METRICS_HISTORY_KEEP)
 
         # -- state machine manager ----------------------------------------
         self.smm = StateMachineManager(
@@ -519,9 +523,7 @@ class Node:
                 for k, v in self.smm.metrics.items()}
         snap["ts"] = round(time.time(), 3)
         snap["flows_in_flight"] = self.smm.in_flight_count
-        self.metrics_history.append(snap)
-        if len(self.metrics_history) > self.METRICS_HISTORY_KEEP:
-            del self.metrics_history[:-self.METRICS_HISTORY_KEEP]
+        self.metrics_history.append(snap)  # deque(maxlen=KEEP) self-trims
 
     def run_forever(self) -> None:
         while True:
@@ -588,6 +590,11 @@ def main(argv: list[str] | None = None) -> int:
     from ..testing import faults as _faults
 
     _faults.arm_from_env(config.name)
+    # Tracing: CORDA_TPU_TRACE=1 (or a span capacity) arms the per-process
+    # SpanRecorder; spans export via /api/trace + the trace_snapshot RPC.
+    from ..obs import trace as _obs
+
+    _obs.arm_from_env(config.name)
     node = Node(config).start()
     print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
     # Attribution hook: CORDA_TPU_NODE_PROFILE=<dir> dumps a cProfile of

@@ -26,6 +26,7 @@ from typing import Any
 
 from ..crypto.hashes import SecureHash
 from ..flows.api import flow_registry
+from ..obs import trace as _obs
 from ..serialization.codec import deserialize, register, serialize
 from ..testing import faults as _faults
 from .messaging.api import Message, MessagingService, TopicSession
@@ -215,6 +216,10 @@ class NodeRpcOps:
             # chaos harness audit what a node actually injected.
             "faults": (_faults.ACTIVE.injected()
                        if _faults.ACTIVE is not None else None),
+            # Tracing recorder stamps (obs/trace.py): recorded/buffered/
+            # dropped span counts, or None while disarmed.
+            "obs": (_obs.ACTIVE.stats()
+                    if _obs.ACTIVE is not None else None),
             # Device-tier degrade bookkeeping (crypto/provider.py
             # degrade_device): demotions and re-probe outcomes.
             "verify_device_degrades": getattr(smm.verifier, "degraded", None),
@@ -222,6 +227,18 @@ class NodeRpcOps:
                 smm.verifier, "reprobes_ok", None),
             "verify_device_reprobes_failed": getattr(
                 smm.verifier, "reprobes_failed", None),
+        }
+
+    def trace_snapshot(self) -> dict:
+        """This node's span buffer (obs/trace.py) for the driver-side trace
+        collector — the RPC twin of GET /api/trace, so the loadtest can
+        gather spans from cluster members that run without a webserver."""
+        rec = _obs.ACTIVE
+        return {
+            "node": self._node.config.name,
+            "armed": rec is not None,
+            "spans": rec.snapshot() if rec is not None else [],
+            "stats": rec.stats() if rec is not None else None,
         }
 
 

@@ -44,6 +44,7 @@ from typing import Any, Callable, Sequence
 
 from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
+from ...obs import trace as _obs
 from ...serialization.codec import deserialize, register, serialize
 from ...testing import faults as _faults
 from ..messaging.api import MessagingService, TopicSession
@@ -315,6 +316,12 @@ class RaftMember:
         # Replication RTT: first-broadcast clock per entry index, popped when
         # quorum commit passes it.
         self._bcast_at: dict[int, float] = {}
+        # Tracing (obs/trace.py), all leader-local and empty when disarmed:
+        # the flow trace ids riding each sealed log entry (idx -> hex list,
+        # popped at quorum commit for the replication span) and the members
+        # of the entry currently being appended (read by _log_append).
+        self._trace_members: dict[int, list] = {}
+        self._obs_members: list | None = None
         # Replication stamps (exported via node_metrics / loadtest / bench):
         # entries-per-batch, reply coalescing, RTT — the self-describing
         # numbers the commit-pipeline work is judged on.
@@ -364,12 +371,22 @@ class RaftMember:
     def _log_append(self, idx: int, term: int, command) -> None:
         if _faults.ACTIVE is not None:
             _faults.fire_fsync("raft.fsync")
+        # Traced only on the leader's seal path (_obs_members set): the
+        # serialize+insert is the raft_append span, the db.commit (sqlite's
+        # fsync point outside batched rounds) is the fsync span.
+        traced = _obs.ACTIVE is not None and self._obs_members is not None
+        t0 = _obs.now() if traced else 0.0
         blob = serialize(command).bytes
         with self.db.lock:
             self.db.conn.execute(
                 "INSERT OR REPLACE INTO raft_log (idx, term, blob) "
                 "VALUES (?, ?, ?)", (idx, term, blob))
+            t1 = _obs.now() if traced else 0.0
             self.db.commit()
+        if traced:
+            attrs = {"member_traces": self._obs_members, "idx": idx}
+            _obs.record("raft_append", t0, t1, attrs=attrs)
+            _obs.record("fsync", t1, _obs.now(), attrs=attrs)
         self._entry_cache[idx] = (term, command)
         self._blob_cache[idx] = (term, blob)
 
@@ -482,13 +499,29 @@ class RaftMember:
         cmds = tuple(self._pending_batch)
         self._pending_batch.clear()
         last_idx, _ = self._log_last()
-        if len(cmds) == 1:
-            self.metrics["solo_commits"] += 1
-            self._log_append(last_idx + 1, self.term, cmds[0])
-        else:
-            self.metrics["group_commits"] += 1
-            self.metrics["group_commands"] += len(cmds)
-            self._log_append(last_idx + 1, self.term, PutAllBatch(cmds))
+        if _obs.ACTIVE is not None:
+            # The flow traces riding this entry (link map filled by
+            # commit_async on THIS process — the hot path, where the flow
+            # node is the leader; forwarded commands have no link and are
+            # an honest attribution gap).
+            members = []
+            for cmd in cmds:
+                link = _obs.ACTIVE.peek_link(cmd.request_id)
+                if link is not None:
+                    members.append(link[0].hex())
+            self._obs_members = members or None
+            if members:
+                self._trace_members[last_idx + 1] = members
+        try:
+            if len(cmds) == 1:
+                self.metrics["solo_commits"] += 1
+                self._log_append(last_idx + 1, self.term, cmds[0])
+            else:
+                self.metrics["group_commits"] += 1
+                self.metrics["group_commands"] += len(cmds)
+                self._log_append(last_idx + 1, self.term, PutAllBatch(cmds))
+        finally:
+            self._obs_members = None
 
     def _flush_forwards(self) -> None:
         """Coalesced follower->leader forwarding: the round's buffered
@@ -553,6 +586,7 @@ class RaftMember:
         self._sent_index.clear()
         self._backoff.clear()
         self._bcast_at.clear()
+        self._trace_members.clear()
 
     def _start_election(self) -> None:
         if self.role == "candidate":
@@ -1014,6 +1048,17 @@ class RaftMember:
                 if t0 is not None:
                     self.metrics["replication_rtt_s"] += now - t0
                     self.metrics["replication_rtt_n"] += 1
+                    if _obs.ACTIVE is not None:
+                        members = self._trace_members.pop(n, None)
+                        if members:
+                            # The RTT clock is monotonic; re-anchor the span
+                            # onto the epoch timeline ending now.
+                            epoch = _obs.now()
+                            _obs.record(
+                                "replication", epoch - (now - t0), epoch,
+                                attrs={"member_traces": members, "idx": n})
+                    else:
+                        self._trace_members.pop(n, None)
         self._apply_committed()
 
     def _record_decision(self, request_id: bytes, reply: ClientReply) -> None:
@@ -1145,11 +1190,28 @@ class RaftUniquenessProvider(UniquenessProvider):
                                 request_id)
         state = {"deadline": _time.monotonic() + self.timeout,
                  "submitted_at": 0.0}
+        ctx = _obs.get_context() if _obs.ACTIVE is not None else None
+        if ctx is not None:
+            # Link map: lets the leader's batch seal attribute this entry
+            # back to the submitting flow's trace without widening the
+            # consensus API. t0 anchors the per-tx raft_commit span.
+            _obs.register_link(request_id, ctx[0], ctx[1])
+            state["trace_t0"] = _obs.now()
 
         def poll():
             now = _time.monotonic()
             reply = self.member.decided.pop(request_id, None)
             if reply is not None:
+                decided = reply.ok or reply.conflict is not None
+                if decided and ctx is not None and _obs.ACTIVE is not None:
+                    # submit -> decision, stitched under the notary flow.
+                    # (A leaderless bounce is not a decision: the command
+                    # resubmits below and the span stays open.)
+                    _obs.record(
+                        "raft_commit", state.get("trace_t0", _obs.now()),
+                        _obs.now(), trace_id=ctx[0], parent=ctx[1],
+                        attrs={"ok": bool(reply.ok)})
+                    _obs.pop_link(request_id)
                 if reply.ok:
                     return True
                 if reply.conflict is not None:

@@ -55,6 +55,7 @@ from ..flows.api import (
     VerifyTxRequest,
     flow_registry,
 )
+from ..obs import trace as _obs
 from ..serialization.codec import deserialize, register, serialize
 from ..serialization.tokens import TokenContext
 from ..testing import faults as _faults
@@ -347,6 +348,13 @@ class FlowStateMachine:
         self._gen = None
         self._replay_cursor = 0
         self.created_at = _time.monotonic()  # per-flow timing
+        # Tracing context (obs/trace.py). All None while disarmed; set by the
+        # manager at creation when obs.ACTIVE is armed. trace_parent is the
+        # initiating peer's span id for session-initiated flows.
+        self.trace_id: bytes | None = None
+        self.trace_span: bytes | None = None
+        self.trace_parent: bytes | None = None
+        self.trace_t0: float = 0.0  # epoch seconds (cross-process merge)
         logic.state_machine = self
         logic.service_hub = manager.service_hub
 
@@ -454,6 +462,18 @@ class FlowStateMachine:
         the manager's pump (single-threaded)."""
         if self.state == _DONE:
             return
+        if _obs.ACTIVE is not None and self.trace_id is not None:
+            # Everything this flow does while stepping — session sends,
+            # service submissions — inherits its trace context.
+            _obs.set_context(self.trace_id, self.trace_span)
+            try:
+                self._step_inner()
+            finally:
+                _obs.clear_context()
+        else:
+            self._step_inner()
+
+    def _step_inner(self) -> None:
         try:
             if self._gen is None:
                 out = self.logic.call()
@@ -627,15 +647,31 @@ class FlowStateMachine:
     def _finish(self, result) -> None:
         self.state = _DONE
         self._progress_done()
+        self._record_root_span()
         self.manager._flow_finished(self)
         self.future.set_result(result)
 
     def _fail(self, exc: BaseException) -> None:
         self.state = _DONE
         self._progress_done()
+        self._record_root_span(failed=True)
         logger.debug("flow %s failed: %s", self.run_id.hex()[:8], exc)
         self.manager._flow_finished(self)
         self.future.set_exception(exc)
+
+    def _record_root_span(self, failed: bool = False) -> None:
+        """The flow's whole-lifetime span — the end-to-end anchor a trace's
+        stage breakdown is measured against (obs/collect.py)."""
+        if _obs.ACTIVE is None or self.trace_id is None:
+            return
+        attrs = {"run_id": self.run_id.hex()}
+        if failed:
+            attrs["failed"] = True
+        _obs.record(
+            f"flow:{type(self.logic).__name__}",
+            self.trace_t0, _obs.now(),
+            trace_id=self.trace_id, span_id=self.trace_span,
+            parent=self.trace_parent, attrs=attrs)
 
     def _progress_done(self) -> None:
         """The framework, not each flow, marks trackers Done on completion —
@@ -795,6 +831,12 @@ class StateMachineManager:
         # collide with checkpoint-restored flows.
         run_id = os.urandom(16)
         fsm = FlowStateMachine(self, logic, run_id)
+        if _obs.ACTIVE is not None:
+            # A client-started flow roots a NEW trace; everything downstream
+            # (sessions, verify batches, raft commits) stitches under it.
+            fsm.trace_id = _obs.new_trace_id()
+            fsm.trace_span = _obs.new_span_id()
+            fsm.trace_t0 = _obs.now()
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
         self._subscribe_progress(logic, run_id)
@@ -966,6 +1008,10 @@ class StateMachineManager:
     ) -> None:
         if not self._verify_queue:
             self._verify_waiting_since = _time.monotonic()
+        if _obs.ACTIVE is not None and fsm.trace_id is not None:
+            # Stamp when this flow's request joined the micro-batch; the
+            # verify_wait span closes when the batch flushes/submits.
+            fsm.trace_verify_enq = _obs.now()
         self._verify_queue.append((fsm, request))
         if isinstance(request, VerifySigRequest):
             self._verify_sig_count += 1
@@ -1007,9 +1053,14 @@ class StateMachineManager:
             return 0
         done = 0
         still_pending = []
+        traced = _obs.ACTIVE is not None
         for fsm, poll in self._service_queue:
             if fsm.state != _WAIT_SERVICE:  # flow died/was restored elsewhere
                 continue
+            if traced and fsm.trace_id is not None:
+                # commit_async submissions inside poll() must carry the
+                # submitting flow's context (raft link registration).
+                _obs.set_context(fsm.trace_id, fsm.trace_span)
             try:
                 outcome = poll()
             except Exception as e:
@@ -1021,6 +1072,8 @@ class StateMachineManager:
             else:
                 fsm.deliver_service_result(value=outcome)
                 done += 1
+        if traced:
+            _obs.clear_context()
         self._service_queue = still_pending
         self.metrics["service_polls"] += 1
         if done:
@@ -1035,11 +1088,26 @@ class StateMachineManager:
         VerifySigRequest (the synchronous path: verify on THIS thread)."""
         batch, self._verify_queue = self._verify_queue, []
         self._verify_sig_count = 0
+        if _obs.ACTIVE is not None:
+            self._record_verify_wait(batch)
         jobs, spans = self._build_verify_jobs(batch)
         ok = self.verifier.verify_batch(jobs) if jobs else []
         self.metrics["verify_batches"] += 1
         self.metrics["verify_sigs"] += len(jobs)
         self._deliver_verify_results(spans, ok)
+
+    def _record_verify_wait(self, batch) -> None:
+        """Close each traced flow's verify_wait span: time from joining the
+        verify micro-batch to the batch leaving the queue (flush or async
+        submit) — the batching-delay component of notarise latency."""
+        now = _obs.now()
+        for fsm, _request in batch:
+            enq = getattr(fsm, "trace_verify_enq", None)
+            if fsm.trace_id is None or enq is None:
+                continue
+            fsm.trace_verify_enq = None
+            _obs.record("verify_wait", enq, now,
+                        trace_id=fsm.trace_id, parent=fsm.trace_span)
 
     def _build_verify_jobs(
         self, batch: "list[tuple[FlowStateMachine, Any]]",
@@ -1118,6 +1186,8 @@ class StateMachineManager:
         self._verify_sig_count = 0
         if not batch:
             return 0
+        if _obs.ACTIVE is not None:
+            self._record_verify_wait(batch)
         jobs, spans = self._build_verify_jobs(batch)
         self.async_verify.submit(jobs, spans)
         return len(jobs)
@@ -1233,6 +1303,12 @@ class StateMachineManager:
         run_id = os.urandom(16)
         self._subscribe_progress(logic, run_id)
         fsm = FlowStateMachine(self, logic, run_id)
+        if _obs.ACTIVE is not None and message.trace is not None:
+            # Session-initiated flow: JOIN the initiator's trace — its span
+            # parents ours, which is how one tx's spans stitch across nodes.
+            fsm.trace_id, fsm.trace_parent = message.trace
+            fsm.trace_span = _obs.new_span_id()
+            fsm.trace_t0 = _obs.now()
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
         local_id = fsm._session_id(fsm.next_session_seq)

@@ -11,6 +11,8 @@ endpoints): a small threaded HTTP server exposing
                                       completion timings
   GET  /api/metrics/history        -> bounded counters time-series (the
                                       JMX/Jolokia capability, Node.kt:313)
+  GET  /api/trace                  -> this node's span buffer (obs/trace.py)
+                                      for the driver-side trace collector
   GET  /api/info                   -> identity + advertised services
   POST /upload/attachment          -> attachment id (content-addressed)
   GET  /attachments/<hex id>       -> the blob
@@ -86,6 +88,20 @@ class NodeWebServer:
             # Bounded time-series ring sampled by the run loop (the
             # JMX/Jolokia counters-over-time capability, Node.kt:313).
             self._json(handler, list(node.metrics_history))
+        elif path == "/api/trace":
+            # This node's span buffer (obs/trace.py), JSON-safe; the
+            # driver-side collector merges many of these into one Chrome
+            # trace artifact. Empty shell when tracing is disarmed so
+            # pollers need no special case.
+            from ..obs import trace as _obs
+
+            rec = _obs.ACTIVE
+            self._json(handler, {
+                "node": node.config.name,
+                "armed": rec is not None,
+                "spans": rec.snapshot() if rec is not None else [],
+                "stats": rec.stats() if rec is not None else None,
+            })
         elif path == "/api/info":
             self._json(handler, {
                 "legal_identity": node.identity.name,

@@ -1,0 +1,19 @@
+"""Observability: cross-node tracing + per-stage latency attribution.
+
+Split like testing/faults.py so the hot path stays cheap:
+
+  trace.py    the per-node SpanRecorder ring buffer, the module-global ACTIVE
+              arming switch, trace-context propagation helpers (thread-local
+              current span + the request-id link map the Raft layer uses to
+              correlate batch entries back to flow traces).
+  collect.py  driver-side: merge many nodes' span snapshots into one Chrome
+              trace-event / Perfetto JSON artifact and compute the per-stage
+              p50/p99 breakdown (queue_wait / verify_wait / device_verify /
+              raft_append / fsync / replication / reply).
+
+Everything here is stdlib-only on purpose: the transports and the state
+machine import `trace` at module load, so it must never pull in jax, the
+serialization codec, or anything else with import-order opinions.
+"""
+
+from . import trace  # noqa: F401  (re-export: corda_tpu.obs.trace)

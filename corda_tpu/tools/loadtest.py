@@ -16,6 +16,8 @@ from its base_dir.
 CLI:
   python -m corda_tpu.tools.loadtest --tx 200 --notary simple
   python -m corda_tpu.tools.loadtest --tx 200 --notary raft --disrupt kill-follower
+  python -m corda_tpu.tools.loadtest --tx 200 --notary raft --processes \
+      --trace /tmp/notary.trace.json   # open in ui.perfetto.dev
 """
 
 from __future__ import annotations
@@ -50,6 +52,7 @@ class LoadTestResult:
     sigs_verified: int
     verify_batches: int
     disruptions: list = field(default_factory=list)
+    trace_file: str | None = None  # merged Chrome/Perfetto JSON (--trace)
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -68,6 +71,42 @@ def _rebuild(config: NodeConfig) -> Node:
         batch=config.batch, verifier=config.verifier)).start()
 
 
+def _collect_trace_snapshots(rpcs) -> list[dict]:
+    """Gather every node process's span buffer over RPC (trace_snapshot is
+    the RPC twin of GET /api/trace). A dead node costs its spans, not the
+    run — the merged trace is honestly partial."""
+    snapshots: list[dict] = []
+    for rpc in rpcs:
+        try:
+            snap = rpc.call("trace_snapshot")
+        except Exception:
+            continue
+        if snap and snap.get("spans"):
+            snapshots.append(snap)
+    return snapshots
+
+
+def _write_trace(path: str, snapshots: list[dict]) -> str | None:
+    if not snapshots:
+        return None
+    from ..obs.collect import write_chrome_trace
+
+    write_chrome_trace(path, snapshots)
+    return path
+
+
+def _inproc_trace_snapshot() -> list[dict]:
+    """Snapshot the process-global recorder for in-process harnesses, where
+    every node shares one ring (spans self-attribute via their node field)."""
+    from ..obs import trace as _obs
+
+    rec = _obs.ACTIVE
+    if rec is None:
+        return []
+    return [{"node": rec.node_name or "inproc", "armed": True,
+             "spans": rec.snapshot(), "stats": rec.stats()}]
+
+
 def run_loadtest(
     n_tx: int = 100,
     notary: str = "simple",  # simple | validating | raft
@@ -77,11 +116,18 @@ def run_loadtest(
     batch: BatchConfig | None = None,
     base_dir: str | None = None,
     max_seconds: float = 120.0,
+    trace: str | None = None,  # write a merged Chrome/Perfetto trace here
 ) -> LoadTestResult:
+    from ..obs import trace as _obs
+
     base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-load-"))
     batch = batch or BatchConfig()
     notaries: list[Node] = []
     disruptions: list[str] = []
+    armed_here = None
+    if trace and _obs.ACTIVE is None:
+        # In-process run: every node shares the process-global recorder.
+        armed_here = _obs.arm("inproc")
 
     if notary == "raft":
         cluster = tuple(f"Raft{i}" for i in range(cluster_size))
@@ -186,6 +232,10 @@ def run_loadtest(
         + sum(m["verify_batches"] for m in notary_metrics),
         disruptions=disruptions,
     )
+    if trace:
+        result.trace_file = _write_trace(trace, _inproc_trace_snapshot())
+        if armed_here is not None:
+            _obs.disarm()
     for n in nodes:
         n.stop()
     return result
@@ -230,6 +280,7 @@ class ChaosResult:
     faults_injected: dict = field(default_factory=dict)
     leader_kill_recovery_s: float | None = None
     disruptions: list = field(default_factory=list)
+    trace_file: str | None = None  # merged Chrome/Perfetto JSON (--trace)
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -246,6 +297,7 @@ def run_chaos_loadtest(
     max_seconds: float = 180.0,
     rate_tx_s: float = 0.0,  # >0: open-loop pacing, latency from schedule
     retry_deadline_s: float = 60.0,
+    trace: str | None = None,  # write a merged Chrome/Perfetto trace here
 ) -> ChaosResult:
     """Chaos mode: an in-process raft cluster + client over REAL TCP and
     sqlite, with a deterministic FaultPlan armed process-wide and/or the
@@ -281,6 +333,11 @@ def run_chaos_loadtest(
     cluster = tuple(f"Raft{i}" for i in range(cluster_size))
     disruptions: list[str] = []
     notaries: list[Node] = []
+    from ..obs import trace as _obs
+
+    armed_here = None
+    if trace and _obs.ACTIVE is None:
+        armed_here = _obs.arm("inproc")
     if plan_obj is not None:
         faults.arm(plan_obj)
     try:
@@ -410,12 +467,16 @@ def run_chaos_loadtest(
             leader_kill_recovery_s=recovery,
             disruptions=disruptions,
         )
+        if trace:
+            result.trace_file = _write_trace(trace, _inproc_trace_snapshot())
         for n in nodes:
             n.stop()
         return result
     finally:
         if plan_obj is not None:
             faults.disarm()
+        if armed_here is not None:
+            _obs.disarm()
 
 
 @dataclass
@@ -445,6 +506,7 @@ class MultiProcessResult:
     # How long the coordinator waited for the device-owning member's warm
     # gate before starting traffic (0.0 when no accelerator is assigned).
     device_warm_wait_s: float = 0.0
+    trace_file: str | None = None  # merged Chrome/Perfetto JSON (--trace)
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -510,6 +572,7 @@ def run_loadtest_multiprocess(
     max_seconds: float = 600.0,
     async_verify: bool = True,  # pipelined verification (all nodes)
     async_depth: int = 2,
+    trace: str | None = None,  # write a merged Chrome/Perfetto trace here
 ) -> MultiProcessResult:
     """The reference-shaped harness: every node is a REAL OS process (its own
     GIL, transport sockets, sqlite), the coordinator only starts firehoses
@@ -534,17 +597,22 @@ def run_loadtest_multiprocess(
     follower_extra = _extra("cpu")
     client_extra = _extra(client_verifier or verifier)
     disruptions: list[str] = []
+    # --trace: arm the span recorder in EVERY node process via the driver's
+    # env vector (node.main() calls obs.trace.arm_from_env beside faults).
+    trace_env = {"CORDA_TPU_TRACE": "1"} if trace else None
+    trace_file = None
     with driver(base) as d:
         members = _start_notary_processes(
             d, notary, cluster_size, toml_extra,
-            follower_extra=follower_extra, device=notary_device, rpc=True)
+            follower_extra=follower_extra, device=notary_device, rpc=True,
+            env_extra=trace_env)
         handles = []
         rpcs = []
         for i in range(clients):
             handles.append(d.start_node(
                 f"Client{i}", rpc=True,
                 cordapps=("corda_tpu.tools.loadgen",),
-                extra_toml=client_extra))
+                extra_toml=client_extra, env_extra=trace_env))
         for h in handles:
             rpcs.append(h.rpc("demo", "s3cret", timeout=60.0))
             d.defer(rpcs[-1].close)
@@ -636,6 +704,9 @@ def run_loadtest_multiprocess(
         stamps = {}
         for m, a in zip(members, after[len(rpcs):]):
             stamps[m.name] = _member_stamp(a, m.device)
+        if trace:
+            trace_file = _write_trace(
+                trace, _collect_trace_snapshots(rpcs + member_rpcs))
 
     sigs = sum(max(0, a["verify_sigs"] - b["verify_sigs"])
                for a, b in zip(after, before))
@@ -660,12 +731,14 @@ def run_loadtest_multiprocess(
         disruptions=disruptions,
         node_stamps=stamps,
         device_warm_wait_s=device_warm_s,
+        trace_file=trace_file,
     )
 
 
 def _start_notary_processes(d, notary: str, cluster_size: int,
                             extra_toml: str, follower_extra: str | None = None,
-                            device: str = "cpu", rpc: bool = False) -> list:
+                            device: str = "cpu", rpc: bool = False,
+                            env_extra: dict | None = None) -> list:
     """Spawn the notary process(es) for a driver run; returns the members.
     For a raft cluster, member 0 gets extra_toml + device (the leader-owns-
     the-device topology: deterministic timeouts make the first member win
@@ -681,11 +754,11 @@ def _start_notary_processes(d, notary: str, cluster_size: int,
             cordapps=("corda_tpu.testing.dummies",), rpc=rpc,
             extra_toml=extra_toml if i == 0 else (follower_extra
                                                   or extra_toml),
-            device=device if i == 0 else "cpu")
+            device=device if i == 0 else "cpu", env_extra=env_extra)
             for i, name in enumerate(cluster)]
     return [d.start_node(
         "Notary", notary=notary, cordapps=("corda_tpu.testing.dummies",),
-        rpc=rpc, extra_toml=extra_toml, device=device)]
+        rpc=rpc, extra_toml=extra_toml, device=device, env_extra=env_extra)]
 
 
 @dataclass
@@ -696,6 +769,9 @@ class SweepResult:
 
     results: dict
     node_stamps: dict = field(default_factory=dict)
+    # Per-node span snapshots (trace_snapshot RPC shape) when the sweep ran
+    # with tracing armed — bench.py feeds these to obs.collect.
+    trace_snapshots: list = field(default_factory=list)
 
     def __getitem__(self, rate):
         return self.results[rate]
@@ -736,6 +812,8 @@ def run_latency_sweep(
     max_seconds: float = 300.0,
     async_verify: bool = True,
     async_depth: int = 2,
+    trace: "str | bool | None" = None,  # True: collect span snapshots onto
+    # the SweepResult; a path additionally writes the merged Chrome trace
 ) -> SweepResult:
     """Open-loop tail-latency measurement: a notary (or raft cluster) + ONE
     client process, the firehose driven at each offered load in `rates`
@@ -763,10 +841,13 @@ def run_latency_sweep(
     toml_extra = _extra(verifier)
     results: dict = {}
     stamps: dict = {}
+    snapshots: list = []
+    trace_env = {"CORDA_TPU_TRACE": "1"} if trace else None
     with driver(base) as d:
         members = _start_notary_processes(
             d, notary, cluster_size, toml_extra,
-            follower_extra=_extra("cpu"), device=notary_device, rpc=True)
+            follower_extra=_extra("cpu"), device=notary_device, rpc=True,
+            env_extra=trace_env)
         member_rpcs = []
         for m in members:
             member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
@@ -785,7 +866,7 @@ def run_latency_sweep(
                 time.sleep(1.0)
         client = d.start_node("Client0", rpc=True,
                               cordapps=("corda_tpu.tools.loadgen",),
-                              extra_toml=_extra("cpu"))
+                              extra_toml=_extra("cpu"), env_extra=trace_env)
         rpc = client.rpc("demo", "s3cret", timeout=60.0)
         d.defer(rpc.close)
         # Warm-up: a tiny closed-loop burst drives session establishment,
@@ -822,7 +903,12 @@ def run_latency_sweep(
                     r.call("node_metrics"), m.device)
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep
-    return SweepResult(results=results, node_stamps=stamps)
+        if trace:
+            snapshots = _collect_trace_snapshots(member_rpcs + [rpc])
+            if isinstance(trace, str):
+                _write_trace(trace, snapshots)
+    return SweepResult(results=results, node_stamps=stamps,
+                       trace_snapshots=snapshots)
 
 
 def main(argv=None) -> int:
@@ -857,6 +943,10 @@ def main(argv=None) -> int:
     ap.add_argument("--kill-leader", action="store_true",
                     help="chaos mode: kill the raft LEADER mid-burst and "
                          "measure recovery (implies chaos mode)")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="record per-stage spans on every node and write "
+                         "one merged Chrome trace-event JSON here (open in "
+                         "chrome://tracing or ui.perfetto.dev)")
     args = ap.parse_args(argv)
     if args.chaos is not None or args.kill_leader:
         result = run_chaos_loadtest(
@@ -864,21 +954,23 @@ def main(argv=None) -> int:
             kill_leader=args.kill_leader, verifier=args.verifier,
             batch=BatchConfig(max_sigs=args.max_sigs,
                               max_wait_ms=args.max_wait_ms),
-            rate_tx_s=args.rate)
+            rate_tx_s=args.rate, trace=args.trace)
     elif args.processes:
         result = run_loadtest_multiprocess(
             n_tx=args.tx, width=args.width, clients=args.clients,
             notary=args.notary, cluster_size=args.cluster_size,
             verifier=args.verifier, inflight=args.inflight,
             rate_tx_s=args.rate, max_sigs=args.max_sigs,
-            max_wait_ms=args.max_wait_ms, disrupt=args.disrupt)
+            max_wait_ms=args.max_wait_ms, disrupt=args.disrupt,
+            trace=args.trace)
     else:
         result = run_loadtest(
             n_tx=args.tx, notary=args.notary,
             cluster_size=args.cluster_size,
             disrupt=args.disrupt, verifier=args.verifier,
             batch=BatchConfig(max_sigs=args.max_sigs,
-                              max_wait_ms=args.max_wait_ms))
+                              max_wait_ms=args.max_wait_ms),
+            trace=args.trace)
     print(result.to_json())
     return 0
 
