@@ -317,6 +317,11 @@ def _verifier_stamp(verifier) -> dict:
         stamp["device_batches"] = verifier.device_batches
         stamp["host_batches"] = verifier.host_batches
         stamp["device_min_sigs"] = verifier.device_min_sigs
+        total = verifier.device_batches + verifier.host_batches
+        # Occupancy at a glance: the r05 regression class (device_batches=0
+        # buried in a long stamp) reads as 0.0 here instead of hiding.
+        stamp["device_occupancy"] = (
+            round(verifier.device_batches / total, 3) if total else 0.0)
         if verifier.device_batches == 0 and verifier.host_batches > 0:
             # The kernel backend did not produce THIS config's numbers —
             # every batch took the host tier (last_backend would report
@@ -550,7 +555,7 @@ def bench_partial_merkle(n_cmds=8, repeats=2000):
 
 
 def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
-                       notary_device="cpu", notary="raft"):
+                       notary_device="cpu", notary="raft", sidecar=False):
     """BASELINE config 1 (raft-notary-demo) at BASELINE size: a real 3-node
     Raft notary cluster, every node its OWN OS process (own GIL, TCP
     sockets, sqlite), firehosed by two client processes running the
@@ -573,13 +578,26 @@ def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
         rounds route to the host tier — node_stamps + routing counters
         attribute exactly where batches went.
     loadtest_sigs_per_sec counts every pump verification across client
-    AND notary processes via RPC metric deltas."""
+    AND notary processes via RPC metric deltas.
+
+    sidecar=True spawns the host's ONE device-owning verification server
+    (crypto/sidecar.py) and points every raft member at it, so verify
+    micro-batches coalesce ACROSS processes — the fix for the r05 flagship
+    shape where every member's batches sat below device_min_sigs and
+    device_batches stayed 0. The "sidecar" field carries the server's
+    stats (batch-size histogram, cross-request coalescing, device/host
+    batches); device_occupancy aggregates the members' routing either way
+    so host-only runs report the same schema."""
     from corda_tpu.tools.loadtest import run_loadtest_multiprocess
 
     res = run_loadtest_multiprocess(
         n_tx=n_tx, width=width, clients=2, notary=notary,
         verifier=verifier, client_verifier="cpu",
-        notary_device=notary_device, max_seconds=420.0)
+        notary_device=notary_device, max_seconds=420.0, sidecar=sidecar)
+    dev_b = sum((s or {}).get("device_batches") or 0
+                for s in res.node_stamps.values())
+    host_b = sum((s or {}).get("host_batches") or 0
+                 for s in res.node_stamps.values())
     return {"harness": "multiprocess-driver", "n_tx": n_tx, "width": width,
             "notary": notary,
             "tx_per_sec": res.tx_per_sec,
@@ -589,6 +607,11 @@ def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
             "p50_ms": res.p50_ms, "p99_ms": res.p99_ms,
             "verifier": verifier, "notary_device": notary_device,
             "device_warm_wait_s": res.device_warm_wait_s,
+            "device_batches": dev_b,
+            "host_batches": host_b,
+            "device_occupancy": (round(dev_b / (dev_b + host_b), 3)
+                                 if (dev_b + host_b) else 0.0),
+            "sidecar": res.sidecar,
             "node_stamps": res.node_stamps}
 
 
@@ -658,7 +681,8 @@ def bench_open_loop_latency():
 
 
 def bench_raft_open_loop(rates=(30.0, 90.0, 150.0), n_tx=200,
-                         verifier="cpu", notary_device="cpu"):
+                         verifier="cpu", notary_device="cpu",
+                         sidecar=False):
     """Open-loop tail latency for the FLAGSHIP config: the 3-member raft
     cluster through real OS processes, firehose paced at stated offered
     loads (round-4 VERDICT item 4 — BASELINE metric 2, p99 notarise
@@ -684,15 +708,24 @@ def bench_raft_open_loop(rates=(30.0, 90.0, 150.0), n_tx=200,
     sweep = run_latency_sweep(rates=rates, n_tx=n_tx, width=4,
                               notary="raft-validating", coalesce_ms=10.0,
                               verifier=verifier, notary_device=notary_device,
-                              trace=True)
+                              trace=True, sidecar=sidecar)
     try:
         breakdown = obs_collect.stage_breakdown(sweep.trace_snapshots)
     except Exception as e:  # a malformed snapshot costs the breakdown only
         breakdown = {"error": f"{type(e).__name__}: {e}"}
+    dev_b = sum((s or {}).get("device_batches") or 0
+                for s in sweep.node_stamps.values())
+    host_b = sum((s or {}).get("host_batches") or 0
+                 for s in sweep.node_stamps.values())
     return {"harness": "multiprocess-driver", "width": 4, "n_tx": n_tx,
             "notary": "raft-validating", "verifier": verifier,
             "notary_device": notary_device,
             "coalesce_ms": 10.0,
+            "device_batches": dev_b,
+            "host_batches": host_b,
+            "device_occupancy": (round(dev_b / (dev_b + host_b), 3)
+                                 if (dev_b + host_b) else 0.0),
+            "sidecar": sweep.sidecar,
             "node_stamps": sweep.node_stamps,
             "replication": _replication_summary(sweep.node_stamps),
             "stage_breakdown": breakdown,
@@ -1220,13 +1253,18 @@ def _run_phases(report: dict) -> None:
     # aggregate the least predictable stretch of the run; config 3 — the
     # 100k synthetic firehose — IS the stream measurement above).
     configs = report["baseline_configs"] = {}
+    # The flagship device phases run with the verification sidecar: ONE
+    # device-owning server all members feed, coalescing micro-batches
+    # across processes (the r05 device_batches=0 fix — crypto/sidecar.py).
     for name, fn in (("raft_notary_3node", bench_raft_cluster),
                      ("raft_validating_3node", lambda: bench_raft_cluster(
                          n_tx=400, notary="raft-validating",
-                         verifier="jax", notary_device="accelerator")),
+                         verifier="jax", notary_device="accelerator",
+                         sidecar=True)),
                      ("open_loop_latency", bench_open_loop_latency),
                      ("raft_open_loop_latency", lambda: bench_raft_open_loop(
-                         verifier="jax", notary_device="accelerator")),
+                         verifier="jax", notary_device="accelerator",
+                         sidecar=True)),
                      ("resolve_ids", bench_resolve_ids),
                      ("trader_dvp", bench_trades),
                      ("composite_3of3", bench_multisig),

@@ -262,6 +262,19 @@ class AsyncVerifyService:
                  "sigs": len(handle.jobs)}
         _obs.record("queue_wait", now - wall - wait, now - wall, attrs=attrs)
         _obs.record("device_verify", now - wall, now, attrs=attrs)
+        sc_wait = getattr(self.verifier, "last_wait_s", None)
+        if handle.tier == "device" and sc_wait is not None:
+            # Sidecar tier: split the batch's device window into the
+            # server-side coalesce wait and verify wall reported in the
+            # newest reply (same fan-in attrs). With depth>1 the newest
+            # reply can belong to a sibling batch — sub-ms skew on spans
+            # whose job is attribution, not timing truth.
+            sc_verify = float(getattr(self.verifier, "last_verify_s", 0.0)
+                              or 0.0)
+            sc_wait = min(float(sc_wait), max(wall - sc_verify, 0.0))
+            _obs.record("sidecar_wait", now - wall, now - wall + sc_wait,
+                        attrs=attrs)
+            _obs.record("sidecar_verify", now - sc_verify, now, attrs=attrs)
 
     def stats(self) -> dict:
         """Pipeline counters for node_metrics / loadtest stamps."""

@@ -1,0 +1,558 @@
+"""Device-owning verification sidecar: cross-process batch coalescing.
+
+The round-5 flagship gap: the Pallas kernel streams ~292k sigs/s, but the
+raft-validating multiprocess loadtest delivered 3.9k sigs/s with
+``device_batches=0`` — each node PROCESS accumulates its own micro-batches,
+every one below device_min_sigs, so all traffic host-routed and the device
+sat idle on exactly the path BASELINE.json measures. Per-process batching
+cannot fix this: the batches are small because each run loop only sees its
+own flows.
+
+This module is the missing seam the north-star design prescribes (PAPER §7:
+micro-batches ship "over a JNI/gRPC bridge to a JAX sidecar" owning the
+accelerator): ONE verification server per host, fed by every node process
+over a local socket, coalescing requests ACROSS processes before dispatch —
+clipper/serving-style adaptive batching (PAPERS.md).
+
+Server structure (mirrors async_verify.py's pipeline, one level up):
+  reader threads   — one per client connection; decode framed requests into
+                     a shared pending queue.
+  scheduler thread — deadline-based coalescing: holds the queue open from
+                     the FIRST pending request for up to coalesce_us,
+                     flushing early when pending sigs reach max_sigs
+                     (bucket capacity). Whole requests only — a request is
+                     never split across batches, so per-client replies stay
+                     one frame.
+  executor thread  — concatenates the coalesced requests into one
+                     verify_batch call on the server's verifier (the
+                     DeviceRoutedVerifier size/gate routing and the padded
+                     pick_bucket executable cache in ops/ed25519_jax are
+                     reused unchanged), then splits results per request.
+  depth-2 buffering: a BoundedSemaphore(depth) between scheduler and
+                     executor lets the scheduler coalesce the NEXT batch
+                     while the current one runs on the device.
+
+Wire protocol — length-prefixed frames over a stream socket (unix path or
+host:port), little-endian throughout:
+  frame    := u32(len) payload
+  request  := u8(op) u32(req_id) body
+  OP_VERIFY  body:  u32(n)  pubkeys n*32  sigs n*64  u32 msg_len[n]  msgs
+  OP_VERIFY  reply: u8(op) u32(req_id) u8(status) u8(tier)
+                    f32(wait_s) f32(verify_s)  u8 ok[n]     (tier: 1=device)
+  OP_STATS   reply: u8(op) u32(req_id) u8(status)  json(stats) utf-8
+  OP_PING    reply: u8(op) u32(req_id) u8(status)
+Only well-formed ed25519 jobs ride the fixed-width arrays; the client
+rejects wrong-length keys/sigs locally (same semantics as the kernel path:
+malformed input rejects, never raises).
+
+Crash contract: the sidecar holds NO durable state. A dead sidecar is an
+infra fault — clients degrade to their local host tier (oracle-exact accept
+set) through provider.degrade_device and re-probe on a cooldown; flows
+in-flight at the moment of death replay at-least-once like any other verify
+infra failure. The sidecar can never make a node commit a wrong answer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Sequence
+
+import numpy as np
+
+from .provider import VerifyJob, make_verifier
+
+OP_VERIFY = 1
+OP_STATS = 2
+OP_PING = 3
+
+STATUS_OK = 0
+STATUS_ERR = 1
+
+# One frame bounds one coalesced request: 64 MiB covers max_sigs=65536 jobs
+# of pubkey+sig+len plus ~900-byte messages — far beyond any pump batch.
+MAX_FRAME = 64 * 1024 * 1024
+
+_FRAME_HDR = struct.Struct("<I")
+_REQ_HDR = struct.Struct("<BI")
+_VERIFY_REQ_HDR = struct.Struct("<BII")
+_REPLY_HDR = struct.Struct("<BIB")
+_VERIFY_REPLY_HDR = struct.Struct("<BIBBff")
+
+# The kernel's padded-bucket ladder (ops/ed25519_jax.pick_bucket), mirrored
+# here so the batch-size histogram keys by executable bucket without this
+# module ever importing jax (stats must work on host-only processes).
+BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+
+
+def bucket_for(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return BUCKETS[-1]
+
+
+# ---------------------------------------------------------------------------
+# Framing + codec (shared by server and node/verify_client.py)
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_FRAME_HDR.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("sidecar connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (ln,) = _FRAME_HDR.unpack(recv_exact(sock, _FRAME_HDR.size))
+    if ln > MAX_FRAME:
+        raise ConnectionError(f"sidecar frame too large: {ln}")
+    return recv_exact(sock, ln)
+
+
+def encode_verify_request(req_id: int, jobs: Sequence[VerifyJob]) -> bytes:
+    """Pack well-formed ed25519 jobs (32-byte keys, 64-byte sigs) into one
+    OP_VERIFY payload. Columnar layout so the server decodes with numpy
+    slices, mirroring the native/_cverify packers."""
+    n = len(jobs)
+    return b"".join((
+        _VERIFY_REQ_HDR.pack(OP_VERIFY, req_id, n),
+        b"".join(bytes(j.pubkey) for j in jobs),
+        b"".join(bytes(j.sig) for j in jobs),
+        np.fromiter((len(j.message) for j in jobs), "<u4", n).tobytes(),
+        b"".join(bytes(j.message) for j in jobs),
+    ))
+
+
+def decode_verify_request(payload: bytes):
+    """-> (req_id, [VerifyJob...]); raises on a malformed frame (the reader
+    drops the connection — a corrupt stream cannot be resynchronised)."""
+    op, req_id, n = _VERIFY_REQ_HDR.unpack_from(payload)
+    off = _VERIFY_REQ_HDR.size
+    pks = payload[off:off + 32 * n]
+    off += 32 * n
+    sigs = payload[off:off + 64 * n]
+    off += 64 * n
+    lens = np.frombuffer(payload, "<u4", n, off)
+    off += 4 * n
+    if len(pks) != 32 * n or len(sigs) != 64 * n:
+        raise ValueError("short sidecar verify request")
+    jobs = []
+    for i in range(n):
+        ln = int(lens[i])
+        msg = payload[off:off + ln]
+        if len(msg) != ln:
+            raise ValueError("short sidecar verify request")
+        off += ln
+        jobs.append(VerifyJob(pks[32 * i:32 * i + 32], msg,
+                              sigs[64 * i:64 * i + 64]))
+    return req_id, jobs
+
+
+def parse_address(address: str):
+    """'host:port' -> ("tcp", (host, port)); anything else is a unix
+    socket path."""
+    if ":" in address and "/" not in address:
+        host, port = address.rsplit(":", 1)
+        return "tcp", (host or "127.0.0.1", int(port))
+    return "unix", address
+
+
+def connect(address: str, timeout: float | None = None) -> socket.socket:
+    kind, addr = parse_address(address)
+    if kind == "tcp":
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    else:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(addr)
+    return sock
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class _Client:
+    """One accepted connection. The write lock serialises replies: verify
+    replies come from the executor thread while stats/ping replies come
+    from the connection's own reader thread."""
+
+    __slots__ = ("conn", "lock")
+
+    def __init__(self, conn: socket.socket):
+        self.conn = conn
+        self.lock = threading.Lock()
+
+    def reply(self, payload: bytes) -> None:
+        with self.lock:
+            send_frame(self.conn, payload)
+
+
+class _Pending:
+    __slots__ = ("client", "req_id", "jobs", "received_at")
+
+    def __init__(self, client: _Client, req_id: int,
+                 jobs: list[VerifyJob]):
+        self.client = client
+        self.req_id = req_id
+        self.jobs = jobs
+        self.received_at = time.perf_counter()
+
+
+_STOP = object()
+
+
+class SidecarServer:
+    """The per-host verification server. One instance owns the device (via
+    its verifier); every node process on the host connects as a client."""
+
+    def __init__(self, address: str, verifier=None, verifier_kind: str = "cpu",
+                 coalesce_us: int = 2000, max_sigs: int = 4096,
+                 depth: int = 2, device_min_sigs: int | None = None):
+        self.address = address
+        self.verifier = verifier if verifier is not None else make_verifier(
+            verifier_kind)
+        if device_min_sigs is not None and hasattr(
+                self.verifier, "device_min_sigs"):
+            self.verifier.device_min_sigs = device_min_sigs
+        self.coalesce_us = int(coalesce_us)
+        self.max_sigs = int(max_sigs)
+        self.depth = int(depth)
+        self._pending: deque[_Pending] = deque()
+        self._cv = threading.Condition()
+        self._exec_q: queue.SimpleQueue = queue.SimpleQueue()
+        # Depth-2 double buffering: the scheduler may have up to `depth`
+        # batches formed-or-running, so it keeps coalescing the next batch
+        # while the executor holds the device.
+        self._slots = threading.BoundedSemaphore(self.depth)
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+        self._clients: list[_Client] = []
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()  # stats counters
+        self.requests = 0
+        self.batches = 0
+        self.sigs = 0
+        self.cross_request_batches = 0
+        self.errors = 0
+        self.batch_sigs_hist: dict[int, int] = {}
+        self.wait_s_total = 0.0
+        self.verify_s_total = 0.0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, warm: bool = True) -> "SidecarServer":
+        kind, addr = parse_address(self.address)
+        if kind == "unix":
+            try:
+                os.unlink(addr)
+            except FileNotFoundError:
+                pass
+            listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            listener.bind(addr)
+        else:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(addr)
+            host, port = listener.getsockname()[:2]
+            self.address = f"{host}:{port}"  # resolve port 0
+        listener.listen(64)
+        self._listener = listener
+        if warm:
+            self._warm_maybe()
+        for target, name in ((self._accept_loop, "sidecar-accept"),
+                             (self._scheduler, "sidecar-scheduler"),
+                             (self._executor, "sidecar-executor")):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _warm_maybe(self) -> None:
+        """Same boot-warm contract as node._warm_verifier_maybe: install a
+        closed device_gate, compile in the background, open the gate when
+        the device answers. Host traffic flows (host-routed) meanwhile."""
+        verifier = self.verifier
+        if not getattr(verifier, "name", "").startswith("jax"):
+            return
+        gate = threading.Event()
+        verifier.device_gate = gate
+
+        def _warm() -> None:
+            try:
+                import jax
+
+                if jax.default_backend() == "cpu":
+                    gate.set()  # CPU-backend compiles are cheap; no warm
+                    return
+                verifier.warm()
+            except Exception:
+                pass  # gate stays closed; degrade/re-probe policy applies
+            finally:
+                gate.set()
+
+        threading.Thread(target=_warm, daemon=True,
+                         name="sidecar-warm").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        self._exec_q.put(_STOP)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            clients = list(self._clients)
+        for c in clients:
+            try:
+                c.conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        kind, addr = parse_address(self.address)
+        if kind == "unix":
+            try:
+                os.unlink(addr)
+            except OSError:
+                pass
+
+    # -- connection handling ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # unix sockets have no TCP options
+            client = _Client(conn)
+            with self._lock:
+                self._clients.append(client)
+            t = threading.Thread(target=self._serve_conn, args=(client,),
+                                 daemon=True, name="sidecar-conn")
+            t.start()
+
+    def _serve_conn(self, client: _Client) -> None:
+        try:
+            while not self._stop.is_set():
+                payload = recv_frame(client.conn)
+                op, req_id = _REQ_HDR.unpack_from(payload)
+                if op == OP_VERIFY:
+                    _, jobs = decode_verify_request(payload)
+                    pend = _Pending(client, req_id, jobs)
+                    with self._cv:
+                        self._pending.append(pend)
+                        self.requests += 1
+                        self._cv.notify_all()
+                elif op == OP_STATS:
+                    body = json.dumps(self.stats()).encode()
+                    client.reply(
+                        _REPLY_HDR.pack(OP_STATS, req_id, STATUS_OK) + body)
+                elif op == OP_PING:
+                    client.reply(_REPLY_HDR.pack(OP_PING, req_id, STATUS_OK))
+                else:
+                    raise ValueError(f"unknown sidecar op {op}")
+        except (ConnectionError, OSError, ValueError, struct.error):
+            pass  # client went away or sent garbage: drop the connection
+        finally:
+            try:
+                client.conn.close()
+            except OSError:
+                pass
+            with self._lock:
+                if client in self._clients:
+                    self._clients.remove(client)
+
+    # -- coalescing scheduler ----------------------------------------------
+
+    def _pending_sigs(self) -> int:
+        return sum(len(p.jobs) for p in self._pending)
+
+    def _scheduler(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending:
+                    if self._stop.is_set():
+                        return
+                    self._cv.wait(0.1)
+                # The deadline anchors on the OLDEST pending request: no
+                # request waits longer than coalesce_us for company.
+                deadline = (self._pending[0].received_at
+                            + self.coalesce_us / 1e6)
+                while (self._pending_sigs() < self.max_sigs
+                       and not self._stop.is_set()):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch: list[_Pending] = []
+                total = 0
+                while self._pending and total < self.max_sigs:
+                    p = self._pending.popleft()
+                    batch.append(p)
+                    total += len(p.jobs)
+            # Blocks while `depth` batches are in flight — backpressure
+            # that keeps the executor at most one batch ahead. Timed so
+            # shutdown can't wedge this thread if the executor exited
+            # without releasing.
+            while not self._slots.acquire(timeout=0.2):
+                if self._stop.is_set():
+                    return
+            if self._stop.is_set():
+                self._slots.release()
+                return
+            self._exec_q.put(batch)
+
+    # -- executor -----------------------------------------------------------
+
+    def _executor(self) -> None:
+        while True:
+            batch = self._exec_q.get()
+            if batch is _STOP:
+                return
+            jobs = [j for p in batch for j in p.jobs]
+            before_dev = getattr(self.verifier, "device_batches", 0) or 0
+            t0 = time.perf_counter()
+            err = None
+            try:
+                ok = self.verifier.verify_batch(jobs)
+            except Exception as exc:  # noqa: BLE001
+                # Providers reject-never-raise, but a dying device backend
+                # can still throw; an error REPLY (not silence) lets the
+                # client degrade immediately instead of eating a deadline.
+                ok, err = None, exc
+            verify_s = time.perf_counter() - t0
+            tier = 1 if (getattr(self.verifier, "device_batches", 0)
+                         or 0) > before_dev else 0
+            with self._lock:
+                self.batches += 1
+                self.sigs += len(jobs)
+                if len(batch) > 1:
+                    self.cross_request_batches += 1
+                if err is not None:
+                    self.errors += 1
+                b = bucket_for(len(jobs))
+                self.batch_sigs_hist[b] = self.batch_sigs_hist.get(b, 0) + 1
+                self.verify_s_total += verify_s
+                self.wait_s_total += sum(t0 - p.received_at for p in batch)
+            offset = 0
+            for p in batch:
+                n = len(p.jobs)
+                head = _VERIFY_REPLY_HDR.pack(
+                    OP_VERIFY, p.req_id,
+                    STATUS_OK if err is None else STATUS_ERR, tier,
+                    t0 - p.received_at, verify_s)
+                if err is None:
+                    body = np.asarray(ok[offset:offset + n],
+                                      bool).astype(np.uint8).tobytes()
+                else:
+                    body = repr(err).encode()[:512]
+                offset += n
+                try:
+                    p.client.reply(head + body)
+                except OSError:
+                    pass  # client died mid-batch: its flows replay
+            self._slots.release()
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        from ..ops import last_backend_if_loaded
+
+        v = self.verifier
+        gate = getattr(v, "device_gate", None)
+        with self._lock:
+            hist = {str(k): self.batch_sigs_hist[k]
+                    for k in sorted(self.batch_sigs_hist)}
+            return {
+                "address": self.address,
+                "verifier": getattr(v, "name", None),
+                "kernel_backend": last_backend_if_loaded(),
+                "requests": self.requests,
+                "batches": self.batches,
+                "sigs": self.sigs,
+                "cross_request_batches": self.cross_request_batches,
+                "errors": self.errors,
+                "batch_sigs_hist": hist,
+                "device_batches": getattr(v, "device_batches", None),
+                "host_batches": getattr(v, "host_batches", None),
+                "device_min_sigs": getattr(v, "device_min_sigs", None),
+                "device_ready": (gate.is_set() if gate is not None
+                                 else None),
+                "coalesce_us": self.coalesce_us,
+                "max_sigs": self.max_sigs,
+                "depth": self.depth,
+                "wait_s_total": round(self.wait_s_total, 6),
+                "verify_s_total": round(self.verify_s_total, 6),
+            }
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="corda_tpu verification sidecar: one device-owning "
+                    "verify server per host")
+    parser.add_argument("--socket", required=True,
+                        help="unix socket path or host:port to listen on")
+    parser.add_argument("--verifier", default="jax",
+                        help="server-side provider (cpu | jax | jax-shadow "
+                             "| jax-sharded)")
+    parser.add_argument("--coalesce-us", type=int, default=2000,
+                        help="max time the oldest request waits for "
+                             "cross-client company")
+    parser.add_argument("--max-sigs", type=int, default=4096,
+                        help="flush a coalesced batch early at this many "
+                             "signatures (bucket capacity)")
+    parser.add_argument("--depth", type=int, default=2,
+                        help="batches formed-or-in-flight (double buffer)")
+    parser.add_argument("--device-min-sigs", type=int, default=None,
+                        help="override the server verifier's size crossover")
+    args = parser.parse_args(argv)
+
+    if args.verifier.startswith("jax"):
+        from ..ops import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
+    server = SidecarServer(
+        args.socket, verifier_kind=args.verifier,
+        coalesce_us=args.coalesce_us, max_sigs=args.max_sigs,
+        depth=args.depth, device_min_sigs=args.device_min_sigs)
+    server.start()
+    # The driver's wait_up parses this banner, like the node's.
+    print(f"sidecar up at {server.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

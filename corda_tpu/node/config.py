@@ -49,6 +49,14 @@ class BatchConfig:
     # Bounded in-flight submitted batches (2 = double buffering: one batch
     # verifying, one filling).
     async_depth: int = 2
+    # Verification sidecar (crypto/sidecar.py): address of a host-local
+    # device-owning verify server — unix socket path or host:port. All node
+    # processes on the host feed the same server so batches coalesce ACROSS
+    # processes. "" disables it: verification routes exactly as before.
+    sidecar: str = ""
+    # Client-side round-trip deadline for one sidecar batch; a miss
+    # degrades the node to its local host tier (cooldown re-probe re-opens).
+    sidecar_deadline_ms: float = 2000.0
 
 
 @dataclass(frozen=True)
@@ -144,6 +152,9 @@ class NodeConfig:
                 coalesce_ms=float(batch.get("coalesce_ms", 0.0)),
                 async_verify=bool(batch.get("async_verify", True)),
                 async_depth=int(batch.get("async_depth", 2)),
+                sidecar=str(batch.get("sidecar", "")),
+                sidecar_deadline_ms=float(
+                    batch.get("sidecar_deadline_ms", 2000.0)),
             ),
             raft=RaftConfig(
                 group_commit=bool(raft.get("group_commit", True)),

@@ -142,12 +142,28 @@ class Node:
         self.metrics_history: deque[dict] = deque(
             maxlen=self.METRICS_HISTORY_KEEP)
 
+        # Verification provider: a configured sidecar address (or the
+        # CORDA_TPU_SIDECAR env the driver plants) swaps in the sidecar
+        # client so this process feeds the host's shared device-owning
+        # server (crypto/sidecar.py). Unset = exactly the local routing
+        # as before.
+        sidecar_addr = config.batch.sidecar or os.environ.get(
+            "CORDA_TPU_SIDECAR", "")
+        if sidecar_addr:
+            from .verify_client import SidecarVerifier
+
+            verifier = SidecarVerifier(
+                sidecar_addr,
+                deadline_ms=config.batch.sidecar_deadline_ms)
+        else:
+            verifier = _make_verifier(config.verifier)
+
         # -- state machine manager ----------------------------------------
         self.smm = StateMachineManager(
             service_hub=self.services,
             messaging=self.messaging,
             checkpoint_storage=DBCheckpointStorage(self.db),
-            verifier=_make_verifier(config.verifier),
+            verifier=verifier,
             our_identity=self.identity,
             defer_verify=True,  # the run loop owns the flush policy
             defer_checkpoints=True,  # run_once flushes once per round

@@ -168,6 +168,8 @@ class NodeRpcOps:
         from ..ops import last_backend_if_loaded
 
         kernel_backend = last_backend_if_loaded()
+        av_stats = (smm.async_verify.stats()
+                    if smm.async_verify is not None else None)
         return dict(smm.metrics) | {
             "flows_in_flight": smm.in_flight_count,
             "verify_pending_sigs": smm.verify_pending_sigs,
@@ -191,11 +193,27 @@ class NodeRpcOps:
             # moves it at runtime); None for verifiers with no device tier.
             "verify_device_min_sigs": getattr(
                 smm.verifier, "device_min_sigs", None),
+            # The EFFECTIVE crossover: AdaptiveCrossover's learned value
+            # previously lived only in memory — stamped so artifacts show
+            # why traffic routed where it did. Falls back to the verifier's
+            # live value when no tuner is attached (same number today,
+            # since the tuner rewrites the verifier in place).
+            "verify_effective_min_sigs": (
+                (av_stats or {}).get(
+                    "effective_min_sigs",
+                    getattr(smm.verifier, "device_min_sigs", None))),
+            "verify_static_min_sigs": (
+                (av_stats or {}).get("static_min_sigs")),
             # Async pipeline counters (crypto/async_verify.py): submitted/
             # in-flight/completed batches, queue wait vs device wall, and
             # the adaptive crossover state; None in synchronous mode.
-            "async_verify": (smm.async_verify.stats()
-                             if smm.async_verify is not None else None),
+            "async_verify": av_stats,
+            # Sidecar client stamps (node/verify_client.py): batches/sigs
+            # shipped to the host's shared verify server, fallbacks,
+            # degrade gate state; None when no sidecar is configured.
+            "sidecar": (smm.verifier.sidecar_stats()
+                        if hasattr(smm.verifier, "sidecar_stats")
+                        else None),
             # Commit-pipeline stamps (services/raft.py): group-commit
             # entries/batch, pipelined-replication frames, reply coalescing,
             # replication RTT; None on non-raft nodes.

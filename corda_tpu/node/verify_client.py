@@ -1,0 +1,250 @@
+"""SidecarVerifier — the node-side client of the verification sidecar.
+
+Plugs into the existing BatchVerifier seam unchanged: node assembly swaps it
+in for the local provider when ``[batch] sidecar`` (or CORDA_TPU_SIDECAR)
+names a server address, and the async feeder, SMM degrade path, metrics
+stamps and adaptive crossover all keep working by duck type — it IS a
+DeviceRoutedVerifier whose "device" is the host-local sidecar socket.
+
+The crossover default is deliberately LOW (16, not 512): shipping a
+micro-batch to the sidecar costs one local-socket round trip, and the
+sidecar amortises the REAL device dispatch across every node process on the
+host. Per-process batching (512 floor) is exactly what left device_batches
+at 0 on the round-5 flagship; the sidecar exists so micro-batches flow out
+and coalesce server-side.
+
+Failure policy — never a wrong answer, never a hang:
+  * Any transport/deadline/protocol failure raises SidecarError from
+    ``_verify_ed25519_device``. The routing override catches it, demotes
+    the sidecar tier through provider.degrade_device (shared gate +
+    cooldown re-probe machinery) and answers the batch from the local host
+    tier, which is oracle-exact. Infra faults degrade; they never reject.
+  * The cooldown re-probe calls ``_verify_ed25519_device`` directly with a
+    garbage batch and interprets "no exception" as healthy — which is why
+    the device method must RAISE on failure rather than falling back
+    internally: an internal fallback would re-open the gate while the
+    sidecar is still dead.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..crypto import sidecar as wire
+from ..crypto.provider import (CpuVerifier, DeviceRoutedVerifier, VerifyJob,
+                               degrade_device)
+
+# Size crossover for the SIDECAR tier (see module doc: low on purpose —
+# the expensive device round trip happens server-side, amortised across
+# processes; the client only pays a local socket RTT).
+SIDECAR_MIN_SIGS_DEFAULT = 16
+
+
+class SidecarError(RuntimeError):
+    """The sidecar failed to answer: dead, deadline missed, or protocol
+    error. Carries no verdicts — the caller re-verifies on the host."""
+
+
+class SidecarVerifier(DeviceRoutedVerifier):
+    """Verifies ed25519 batches through the per-host sidecar server."""
+
+    name = "sidecar"  # must NOT start with "jax": the node's local warm
+    #                   path and jax-only stamping do not apply here
+
+    def __init__(self, address: str, deadline_ms: float = 2000.0,
+                 device_min_sigs: int | None = None,
+                 connect_timeout_s: float = 1.0,
+                 reprobe_cooldown_s: float | None = None):
+        if device_min_sigs is None:
+            device_min_sigs = int(os.environ.get(
+                "CORDA_TPU_SIDECAR_MIN_SIGS", SIDECAR_MIN_SIGS_DEFAULT))
+        super().__init__(device_min_sigs=device_min_sigs)
+        self.address = address
+        self.deadline_s = float(deadline_ms) / 1e3
+        self.connect_timeout_s = connect_timeout_s
+        self.reprobe_cooldown_s = reprobe_cooldown_s
+        self._sock: socket.socket | None = None
+        self._req_id = 0
+        # Serialises the socket: the feeder thread and the degrade
+        # re-probe thread may both round-trip; one framed request/reply
+        # pair at a time keeps req_id matching trivial.
+        self._io_lock = threading.Lock()
+        self.sidecar_batches = 0
+        self.sidecar_sigs = 0
+        self.fallbacks = 0
+        self.connects = 0
+        self.rpc_s_total = 0.0
+        # Server-reported timings of the newest answered batch; the async
+        # feeder turns these into sidecar_wait/sidecar_verify spans.
+        self.last_wait_s: float | None = None
+        self.last_verify_s: float | None = None
+        self.last_tier: str | None = None
+
+    # -- routing ------------------------------------------------------------
+
+    def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        if (len(jobs) < self.device_min_sigs
+                or (self.device_gate is not None
+                    and not self.device_gate.is_set())):
+            self.host_batches += 1
+            return CpuVerifier._verify_ed25519_host(jobs)
+        try:
+            out = self._verify_ed25519_device(jobs)
+        except SidecarError:
+            # Hard fallback: demote the sidecar tier (gate + cooldown
+            # re-probe) and answer from the oracle-exact host path.
+            self.fallbacks += 1
+            degrade_device(self, cooldown_s=self.reprobe_cooldown_s)
+            self.host_batches += 1
+            return CpuVerifier._verify_ed25519_host(jobs)
+        self.device_batches += 1
+        return out
+
+    # -- the sidecar round trip --------------------------------------------
+
+    def _verify_ed25519_device(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
+        """One framed OP_VERIFY round trip. Raises SidecarError on ANY
+        failure — this method doubles as the degrade re-probe ("the
+        sidecar answered a batch" == healthy), so it must never fall back
+        internally."""
+        # Wrong-length keys/sigs can't ride the fixed-width wire arrays;
+        # they reject locally — identical semantics to the kernel path
+        # (malformed input rejects, never raises).
+        good_idx = [i for i, j in enumerate(jobs)
+                    if len(j.pubkey) == 32 and len(j.sig) == 64]
+        out = np.zeros(len(jobs), bool)
+        if not good_idx:
+            return out
+        good = (list(jobs) if len(good_idx) == len(jobs)
+                else [jobs[i] for i in good_idx])
+        t0 = time.perf_counter()
+        with self._io_lock:
+            deadline = time.perf_counter() + self.deadline_s
+            try:
+                sock = self._connect_maybe()
+                self._req_id += 1
+                req_id = self._req_id
+                sock.settimeout(max(0.05, deadline - time.perf_counter()))
+                wire.send_frame(sock,
+                                wire.encode_verify_request(req_id, good))
+                while True:
+                    sock.settimeout(max(0.05,
+                                        deadline - time.perf_counter()))
+                    payload = wire.recv_frame(sock)
+                    (op, rid, status, tier, wait_s,
+                     verify_s) = wire._VERIFY_REPLY_HDR.unpack_from(payload)
+                    if op == wire.OP_VERIFY and rid == req_id:
+                        break  # anything else is a stale/odd frame: skip
+                if status != wire.STATUS_OK:
+                    detail = payload[wire._VERIFY_REPLY_HDR.size:].decode(
+                        errors="replace")
+                    raise SidecarError(
+                        f"sidecar verify failed: {detail or 'error'}")
+                flags = np.frombuffer(
+                    payload, np.uint8,
+                    offset=wire._VERIFY_REPLY_HDR.size).astype(bool)
+                if len(flags) != len(good):
+                    raise SidecarError("short sidecar reply")
+            except (OSError, ConnectionError, socket.timeout, struct.error,
+                    ValueError) as exc:
+                # Half-answered streams can't be resumed; reconnect fresh
+                # next time (also what makes the re-probe meaningful).
+                self._drop_connection()
+                raise SidecarError(
+                    f"sidecar {self.address}: {exc}") from exc
+        self.sidecar_batches += 1
+        self.sidecar_sigs += len(good)
+        self.rpc_s_total += time.perf_counter() - t0
+        self.last_wait_s = float(wait_s)
+        self.last_verify_s = float(verify_s)
+        self.last_tier = "device" if tier else "host"
+        if len(good_idx) == len(jobs):
+            return flags
+        out[good_idx] = flags
+        return out
+
+    def warm(self) -> None:
+        """Ping the server (connectivity check; nothing to compile on the
+        client side — the SERVER owns device warm-up)."""
+        with self._io_lock:
+            try:
+                sock = self._connect_maybe()
+                self._req_id += 1
+                sock.settimeout(self.connect_timeout_s)
+                wire.send_frame(
+                    sock, wire._REQ_HDR.pack(wire.OP_PING, self._req_id))
+                wire.recv_frame(sock)
+            except (OSError, ConnectionError, struct.error) as exc:
+                self._drop_connection()
+                raise SidecarError(
+                    f"sidecar {self.address}: {exc}") from exc
+
+    def _connect_maybe(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = wire.connect(self.address,
+                                      timeout=self.connect_timeout_s)
+            self.connects += 1
+        return self._sock
+
+    def _drop_connection(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- stamping -----------------------------------------------------------
+
+    def sidecar_stats(self) -> dict:
+        """Client-side view for node_metrics / loadtest node_stamps."""
+        gate = self.device_gate
+        return {
+            "address": self.address,
+            "deadline_ms": self.deadline_s * 1e3,
+            "min_sigs": self.device_min_sigs,
+            "batches": self.sidecar_batches,
+            "sigs": self.sidecar_sigs,
+            "fallbacks": self.fallbacks,
+            "connects": self.connects,
+            "rpc_s_total": round(self.rpc_s_total, 6),
+            "last_wait_s": self.last_wait_s,
+            "last_verify_s": self.last_verify_s,
+            "last_tier": self.last_tier,
+            "gate_open": gate.is_set() if gate is not None else None,
+            "degraded": self.degraded,
+            "reprobes_ok": self.reprobes_ok,
+            "reprobes_failed": self.reprobes_failed,
+        }
+
+
+def fetch_sidecar_stats(address: str, timeout: float = 2.0) -> dict:
+    """One-shot OP_STATS round trip on a fresh connection — harness-side
+    artifact gathering (loadtest/bench). Raises SidecarError when the
+    server is unreachable."""
+    try:
+        sock = wire.connect(address, timeout=timeout)
+        try:
+            sock.settimeout(timeout)
+            wire.send_frame(sock, wire._REQ_HDR.pack(wire.OP_STATS, 1))
+            payload = wire.recv_frame(sock)
+            op, _, status = wire._REPLY_HDR.unpack_from(payload)
+            if op != wire.OP_STATS or status != wire.STATUS_OK:
+                raise ValueError("bad sidecar stats reply")
+            import json
+
+            return json.loads(payload[wire._REPLY_HDR.size:].decode())
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    except (OSError, ConnectionError, ValueError, struct.error) as exc:
+        raise SidecarError(f"sidecar {address}: {exc}") from exc

@@ -25,6 +25,50 @@ def last_backend_if_loaded():
         return None
 
 
+_CPU_SIG: str | None = None
+
+
+def host_cpu_signature() -> str:
+    """Stable 8-hex signature of THIS host's CPU feature set.
+
+    XLA's persistent cache stores AOT-compiled HOST code alongside device
+    executables: an entry compiled on a machine with (say) AVX-512 and
+    loaded on one without it is a latent SIGILL — MULTICHIP r05's tail was
+    full of cpu_aot_loader "Target machine feature ... not supported on the
+    host machine" warnings because one shared cache dir served two machine
+    types. Every default cache dir (here, the driver's node env, the
+    multichip entrypoints) is keyed by this signature so each machine type
+    gets its own partition; an explicit CORDA_TPU_JAX_CACHE still wins."""
+    global _CPU_SIG
+    if _CPU_SIG is None:
+        import hashlib
+        import platform
+
+        feats = ""
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    # x86 "flags", arm64 "Features"; sorted so kernel
+                    # ordering changes don't shift the key.
+                    if line.startswith(("flags", "Features")):
+                        feats = " ".join(sorted(
+                            line.split(":", 1)[1].split()))
+                        break
+        except OSError:
+            pass  # non-procfs platform: machine arch alone partitions
+        raw = f"{platform.machine()}|{feats}"
+        _CPU_SIG = hashlib.sha256(raw.encode()).hexdigest()[:8]
+    return _CPU_SIG
+
+
+def default_jax_cache_dir() -> str:
+    """The shared per-uid, per-machine-type XLA cache path — the ONE
+    default used by enable_persistent_compile_cache, the driver's spawned
+    node env and the bench/multichip entrypoints, so warm-ups in one
+    process hit from every other on the same machine."""
+    return f"/tmp/corda_tpu_jax_cache_{_os.getuid()}_{host_cpu_signature()}"
+
+
 def enable_persistent_compile_cache() -> None:
     """Point XLA's persistent compilation cache at a machine-local dir so
     the kernel zoo compiles once per MACHINE, not once per process. Every
@@ -35,10 +79,10 @@ def enable_persistent_compile_cache() -> None:
     setting CORDA_TPU_JAX_CACHE to an empty string."""
     cache_dir = _os.environ.get("CORDA_TPU_JAX_CACHE")
     if cache_dir is None:
-        # Per-uid default: a world-predictable shared /tmp path would let
-        # another local user plant compiled-code artifacts (and two users
-        # would collide on directory ownership anyway).
-        cache_dir = f"/tmp/corda_tpu_jax_cache_{_os.getuid()}"
+        # Per-uid (a world-predictable shared /tmp path would let another
+        # local user plant compiled-code artifacts) and per-CPU-signature
+        # (see host_cpu_signature: cross-machine-type reuse risks SIGILL).
+        cache_dir = default_jax_cache_dir()
     if not cache_dir:
         return
     try:

@@ -214,6 +214,69 @@ class NodeProcess:
             self.process.wait(timeout=5)
 
 
+@dataclass
+class SidecarProcess:
+    """Handle on a spawned verification sidecar (crypto/sidecar.py) — the
+    one device-owning verify server every node process on the host feeds.
+    Implements the Popen-subset methods stop_all uses, so it rides the
+    driver's node list for lifecycle."""
+
+    name: str
+    base_dir: Path
+    address: str  # unix socket path or host:port
+    process: object  # Host.spawn handle (Popen subset)
+    host: Host = field(default_factory=LocalHost)
+
+    @property
+    def log_path(self) -> Path:
+        return self.base_dir / "sidecar.log"
+
+    def wait_up(self, timeout: float = 60.0) -> "SidecarProcess":
+        deadline = time.monotonic() + timeout
+        prefix = "sidecar up at "
+        while time.monotonic() < deadline:
+            if self.process.poll() is not None:
+                tail = ""
+                try:
+                    tail = self.host.read_text(self.log_path)[-2000:]
+                except OSError:
+                    pass
+                raise RuntimeError(
+                    f"sidecar {self.name} exited with "
+                    f"{self.process.returncode}:\n{tail}")
+            try:
+                text = self.host.read_text(self.log_path)
+            except OSError:
+                text = ""
+            for line in text.splitlines():
+                if line.startswith(prefix):
+                    # tcp with port 0 resolves here; unix echoes the path
+                    self.address = line[len(prefix):].strip()
+                    return self
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"sidecar {self.name} did not come up in {timeout}s")
+
+    def kill(self) -> None:
+        """SIGKILL mid-batch — the kill-sidecar chaos primitive: clients
+        must degrade to their host tier and flows replay, never mis-commit."""
+        self.process.kill()
+        self.process.wait(timeout=10)
+
+    def sigcont(self) -> None:
+        import signal
+
+        self.process.send_signal(signal.SIGCONT)
+
+    def terminate(self) -> None:
+        self.process.terminate()
+        try:
+            self.process.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.process.kill()
+            self.process.wait(timeout=5)
+
+
 def render_node_config(name: str, node_dir, netmap, notary: str = "none",
                        raft_cluster: tuple[str, ...] = (),
                        cordapps: tuple[str, ...] = (),
@@ -269,10 +332,15 @@ def _node_env(device: str) -> dict:
         # pays the FULL Pallas/XLA compile on its first >=device_min_sigs
         # batch — measured as a multi-minute in-measurement stall (r5: the
         # raft-validating p99 hit 133 s while transactions queued behind
-        # the compile). bench.py warms the same cache dir, so a child that
-        # inherits it compiles once per machine, not once per process.
-        env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                       "/tmp/corda_tpu_jax_cache")
+        # the compile). bench.py warms the same cache dir (both resolve
+        # through ops.default_jax_cache_dir), so a child that inherits it
+        # compiles once per machine, not once per process. The dir is
+        # keyed by host CPU signature: XLA stores AOT host code, and a
+        # cache shared across machine types risks SIGILL (MULTICHIP r05
+        # cpu_aot_loader machine-feature-mismatch warnings).
+        from ..ops import default_jax_cache_dir
+
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", default_jax_cache_dir())
         env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     else:
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -321,6 +389,47 @@ class Driver:
             node_dir / "node.log", self._NODE_CWD, env)
         handle = NodeProcess(name, node_dir, config_path, process,
                              rpc_users=rpc_users, device=device, host=host)
+        self.nodes.append(handle)
+        if wait:
+            handle.wait_up()
+        return handle
+
+    _SIDECAR_ARGV = [sys.executable, "-m", "corda_tpu.crypto.sidecar"]
+
+    def start_sidecar(self, name: str = "sidecar", verifier: str = "jax",
+                      device: str = "accelerator", coalesce_us: int = 2000,
+                      max_sigs: int = 4096, depth: int = 2,
+                      address: str | None = None,
+                      env_extra: dict | None = None,
+                      wait: bool = True,
+                      host: Host | None = None) -> SidecarProcess:
+        """Spawn ONE verification sidecar for the host (crypto/sidecar.py).
+        Point node processes at it via `[batch] sidecar = "<address>"` (or
+        CORDA_TPU_SIDECAR in env_extra) so their verify batches coalesce
+        across processes. Default address: a unix socket under the
+        sidecar's base dir (falls back to a short /tmp dir when the path
+        would blow the ~108-byte AF_UNIX limit)."""
+        host = host or self.host
+        side_dir = self.base_dir / name
+        host.mkdir(side_dir)
+        if address is None:
+            address = str(side_dir / "sc.sock")
+            if len(address) > 90:
+                import tempfile
+
+                address = str(Path(tempfile.mkdtemp(
+                    prefix="corda-tpu-sc-")) / "sc.sock")
+        env = _node_env(device)
+        if env_extra:
+            env.update({k: str(v) for k, v in env_extra.items()})
+        argv = self._SIDECAR_ARGV + [
+            "--socket", address, "--verifier", verifier,
+            "--coalesce-us", str(coalesce_us),
+            "--max-sigs", str(max_sigs), "--depth", str(depth)]
+        process = host.spawn(argv, side_dir / "sidecar.log",
+                             self._NODE_CWD, env)
+        handle = SidecarProcess(name, side_dir, address, process, host=host)
+        # Rides the node list so stop_all terminates it with the cluster.
         self.nodes.append(handle)
         if wait:
             handle.wait_up()

@@ -507,6 +507,11 @@ class MultiProcessResult:
     # gate before starting traffic (0.0 when no accelerator is assigned).
     device_warm_wait_s: float = 0.0
     trace_file: str | None = None  # merged Chrome/Perfetto JSON (--trace)
+    # Server-side stats of the host's verification sidecar
+    # (crypto/sidecar.py stats(): batch-size histogram, cross-request
+    # coalescing counts, device/host batches); None when the run did not
+    # use a sidecar.
+    sidecar: dict | None = None
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -526,13 +531,34 @@ def _member_stamp(metrics: dict, device: str) -> dict:
                if (wall + in_loop) > 0 else None)
     raft = metrics.get("raft") or {}
     transport = metrics.get("transport") or {}
+    dev_b = metrics.get("verify_device_batches") or 0
+    host_b = metrics.get("verify_host_batches") or 0
     return {"verifier": metrics.get("verifier"),
             "kernel_backend": metrics.get("kernel_backend"),
             "device": device,
             "device_batches": metrics.get("verify_device_batches"),
             "host_batches": metrics.get("verify_host_batches"),
+            # Fraction of this member's verify batches the device tier
+            # actually served (0.0 = everything host-routed — the r05
+            # regression shape; None when no batch ran at all).
+            "device_occupancy": (round(dev_b / (dev_b + host_b), 3)
+                                 if (dev_b + host_b) else None),
             "device_ready": metrics.get("verify_device_ready"),
             "device_min_sigs": metrics.get("verify_device_min_sigs"),
+            # The EFFECTIVE size crossover in force at stamp time —
+            # AdaptiveCrossover moves it at runtime, and without this the
+            # artifact can't explain why traffic routed where it did.
+            "effective_min_sigs": metrics.get(
+                "verify_effective_min_sigs",
+                av.get("effective_min_sigs",
+                       metrics.get("verify_device_min_sigs"))),
+            "static_min_sigs": metrics.get(
+                "verify_static_min_sigs", av.get("static_min_sigs")),
+            "adaptive_adjustments": av.get("adaptive_adjustments"),
+            # Sidecar CLIENT stamps (node/verify_client.py): batches/sigs
+            # shipped to the shared server, fallbacks, gate state; None
+            # when this member runs without a sidecar.
+            "sidecar": metrics.get("sidecar"),
             "async_verify": av or None,
             "pipeline_depth": av.get("depth"),
             "overlap_ratio": overlap,
@@ -573,6 +599,10 @@ def run_loadtest_multiprocess(
     async_verify: bool = True,  # pipelined verification (all nodes)
     async_depth: int = 2,
     trace: str | None = None,  # write a merged Chrome/Perfetto trace here
+    sidecar: bool = False,  # spawn ONE verification sidecar for the host;
+    # every raft member feeds it, so micro-batches coalesce ACROSS
+    # processes (crypto/sidecar.py) instead of host-routing per process
+    sidecar_coalesce_us: int = 2000,
 ) -> MultiProcessResult:
     """The reference-shaped harness: every node is a REAL OS process (its own
     GIL, transport sockets, sqlite), the coordinator only starts firehoses
@@ -582,26 +612,40 @@ def run_loadtest_multiprocess(
     from ..testing.driver import driver
 
     base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-mp-"))
-    def _extra(v: str) -> str:
-        return (f'verifier = "{v}"\n'
-                f"[batch]\nmax_sigs = {max_sigs}\n"
-                f"max_wait_ms = {max_wait_ms}\n"
-                f"coalesce_ms = {coalesce_ms}\n"
-                f"async_verify = {str(async_verify).lower()}\n"
-                f"async_depth = {async_depth}\n")
+    def _extra(v: str, sidecar_addr: str = "") -> str:
+        out = (f'verifier = "{v}"\n'
+               f"[batch]\nmax_sigs = {max_sigs}\n"
+               f"max_wait_ms = {max_wait_ms}\n"
+               f"coalesce_ms = {coalesce_ms}\n"
+               f"async_verify = {str(async_verify).lower()}\n"
+               f"async_depth = {async_depth}\n")
+        if sidecar_addr:
+            out += f"sidecar = {json.dumps(sidecar_addr)}\n"
+        return out
 
-    toml_extra = _extra(verifier)
-    # Followers stay on the host crypto path even when the leader runs a
-    # device verifier: an election flip must degrade to host crypto, not
-    # stall a cpu-pinned process behind an in-round XLA compile.
-    follower_extra = _extra("cpu")
-    client_extra = _extra(client_verifier or verifier)
     disruptions: list[str] = []
     # --trace: arm the span recorder in EVERY node process via the driver's
     # env vector (node.main() calls obs.trace.arm_from_env beside faults).
     trace_env = {"CORDA_TPU_TRACE": "1"} if trace else None
     trace_file = None
+    side_stats = None
     with driver(base) as d:
+        side = None
+        if sidecar:
+            # The sidecar — not any member — owns the device: all members
+            # ship micro-batches to it and it coalesces across processes.
+            side = d.start_sidecar(
+                verifier=verifier, device=notary_device,
+                coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
+                env_extra=trace_env)
+        side_addr = side.address if side is not None else ""
+        toml_extra = _extra(verifier, side_addr)
+        # Followers stay on the host crypto path even when the leader runs
+        # a device verifier: an election flip must degrade to host crypto,
+        # not stall a cpu-pinned process behind an in-round XLA compile.
+        # (With a sidecar, followers feed the same server instead.)
+        follower_extra = _extra("cpu", side_addr)
+        client_extra = _extra(client_verifier or verifier)
         members = _start_notary_processes(
             d, notary, cluster_size, toml_extra,
             follower_extra=follower_extra, device=notary_device, rpc=True,
@@ -625,7 +669,27 @@ def run_loadtest_multiprocess(
             member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
             d.defer(member_rpcs[-1].close)
         device_warm_s = 0.0
-        if notary_device == "accelerator":
+        if side is not None and notary_device == "accelerator":
+            # Sidecar topology: the warm gate lives in the SIDECAR process
+            # (members run the sidecar client, which has no local gate), so
+            # readiness polls the server's stats endpoint. Same 420 s
+            # budget and same honesty fallback: a dead tunnel measures the
+            # (stamped) host path.
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            t_warm = time.perf_counter()
+            deadline = time.monotonic() + 420.0
+            while time.monotonic() < deadline:
+                try:
+                    ready = fetch_sidecar_stats(
+                        side.address).get("device_ready")
+                except SidecarError:
+                    ready = False
+                if ready or ready is None:
+                    break
+                time.sleep(1.0)
+            device_warm_s = round(time.perf_counter() - t_warm, 1)
+        elif notary_device == "accelerator":
             # Production shape: a device-owning notary warms its kernel at
             # boot (node.py _warm_verifier_maybe) and takes traffic only
             # once warm — otherwise every batch host-routes behind the
@@ -704,6 +768,13 @@ def run_loadtest_multiprocess(
         stamps = {}
         for m, a in zip(members, after[len(rpcs):]):
             stamps[m.name] = _member_stamp(a, m.device)
+        if side is not None:
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            try:
+                side_stats = fetch_sidecar_stats(side.address)
+            except SidecarError:
+                side_stats = {"error": "sidecar unreachable at gather"}
         if trace:
             trace_file = _write_trace(
                 trace, _collect_trace_snapshots(rpcs + member_rpcs))
@@ -732,6 +803,7 @@ def run_loadtest_multiprocess(
         node_stamps=stamps,
         device_warm_wait_s=device_warm_s,
         trace_file=trace_file,
+        sidecar=side_stats,
     )
 
 
@@ -772,6 +844,9 @@ class SweepResult:
     # Per-node span snapshots (trace_snapshot RPC shape) when the sweep ran
     # with tracing armed — bench.py feeds these to obs.collect.
     trace_snapshots: list = field(default_factory=list)
+    # Server-side verification-sidecar stats for the whole sweep
+    # (crypto/sidecar.py stats()); None when the sweep ran without one.
+    sidecar: dict | None = None
 
     def __getitem__(self, rate):
         return self.results[rate]
@@ -814,6 +889,9 @@ def run_latency_sweep(
     async_depth: int = 2,
     trace: "str | bool | None" = None,  # True: collect span snapshots onto
     # the SweepResult; a path additionally writes the merged Chrome trace
+    sidecar: bool = False,  # one host-wide verification sidecar; members
+    # feed it so batches coalesce across processes (crypto/sidecar.py)
+    sidecar_coalesce_us: int = 2000,
 ) -> SweepResult:
     """Open-loop tail-latency measurement: a notary (or raft cluster) + ONE
     client process, the firehose driven at each offered load in `rates`
@@ -830,29 +908,55 @@ def run_latency_sweep(
     from ..testing.driver import driver
 
     base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-lat-"))
-    def _extra(v: str) -> str:
-        return (f'verifier = "{v}"\n'
-                f"[batch]\nmax_sigs = {max_sigs}\n"
-                f"max_wait_ms = {max_wait_ms}\n"
-                f"coalesce_ms = {coalesce_ms}\n"
-                f"async_verify = {str(async_verify).lower()}\n"
-                f"async_depth = {async_depth}\n")
+    def _extra(v: str, sidecar_addr: str = "") -> str:
+        out = (f'verifier = "{v}"\n'
+               f"[batch]\nmax_sigs = {max_sigs}\n"
+               f"max_wait_ms = {max_wait_ms}\n"
+               f"coalesce_ms = {coalesce_ms}\n"
+               f"async_verify = {str(async_verify).lower()}\n"
+               f"async_depth = {async_depth}\n")
+        if sidecar_addr:
+            out += f"sidecar = {json.dumps(sidecar_addr)}\n"
+        return out
 
-    toml_extra = _extra(verifier)
     results: dict = {}
     stamps: dict = {}
     snapshots: list = []
+    side_stats = None
     trace_env = {"CORDA_TPU_TRACE": "1"} if trace else None
     with driver(base) as d:
+        side = None
+        if sidecar:
+            side = d.start_sidecar(
+                verifier=verifier, device=notary_device,
+                coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
+                env_extra=trace_env)
+        side_addr = side.address if side is not None else ""
+        toml_extra = _extra(verifier, side_addr)
         members = _start_notary_processes(
             d, notary, cluster_size, toml_extra,
-            follower_extra=_extra("cpu"), device=notary_device, rpc=True,
-            env_extra=trace_env)
+            follower_extra=_extra("cpu", side_addr), device=notary_device,
+            rpc=True, env_extra=trace_env)
         member_rpcs = []
         for m in members:
             member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
             d.defer(member_rpcs[-1].close)
-        if notary_device == "accelerator":
+        if side is not None and notary_device == "accelerator":
+            # The warm gate lives in the sidecar process (see the
+            # multiprocess harness): poll the server's stats endpoint.
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            deadline = time.monotonic() + 420.0
+            while time.monotonic() < deadline:
+                try:
+                    ready = fetch_sidecar_stats(
+                        side.address).get("device_ready")
+                except SidecarError:
+                    ready = False
+                if ready or ready is None:
+                    break
+                time.sleep(1.0)
+        elif notary_device == "accelerator":
             # Same policy as the multiprocess harness: take traffic only
             # once the device-owning member's warm gate opens, else the
             # whole sweep measures the gated host path. Bounded — a dead
@@ -903,12 +1007,19 @@ def run_latency_sweep(
                     r.call("node_metrics"), m.device)
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep
+        if side is not None:
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            try:
+                side_stats = fetch_sidecar_stats(side.address)
+            except SidecarError:
+                side_stats = {"error": "sidecar unreachable at gather"}
         if trace:
             snapshots = _collect_trace_snapshots(member_rpcs + [rpc])
             if isinstance(trace, str):
                 _write_trace(trace, snapshots)
     return SweepResult(results=results, node_stamps=stamps,
-                       trace_snapshots=snapshots)
+                       trace_snapshots=snapshots, sidecar=side_stats)
 
 
 def main(argv=None) -> int:
@@ -947,7 +1058,22 @@ def main(argv=None) -> int:
                     help="record per-stage spans on every node and write "
                          "one merged Chrome trace-event JSON here (open in "
                          "chrome://tracing or ui.perfetto.dev)")
+    ap.add_argument("--notary-device", choices=("cpu", "accelerator"),
+                    default="cpu",
+                    help="device the first notary member (or the sidecar, "
+                         "with --sidecar) owns; --processes mode only")
+    ap.add_argument("--sidecar", action="store_true",
+                    help="spawn ONE verification sidecar for the host and "
+                         "point every notary member at it, coalescing "
+                         "verify batches ACROSS processes "
+                         "(crypto/sidecar.py; --processes mode only). "
+                         "If the sidecar dies, members degrade to their "
+                         "local host tier and re-probe on a cooldown — "
+                         "at-least-once replay, never a wrong answer")
     args = ap.parse_args(argv)
+    if args.sidecar and not args.processes:
+        ap.error("--sidecar requires --processes (one sidecar per HOST "
+                 "only makes sense with real OS-process nodes)")
     if args.chaos is not None or args.kill_leader:
         result = run_chaos_loadtest(
             plan=args.chaos, n_tx=args.tx, cluster_size=args.cluster_size,
@@ -962,7 +1088,8 @@ def main(argv=None) -> int:
             verifier=args.verifier, inflight=args.inflight,
             rate_tx_s=args.rate, max_sigs=args.max_sigs,
             max_wait_ms=args.max_wait_ms, disrupt=args.disrupt,
-            trace=args.trace)
+            notary_device=args.notary_device,
+            trace=args.trace, sidecar=args.sidecar)
     else:
         result = run_loadtest(
             n_tx=args.tx, notary=args.notary,

@@ -22,6 +22,10 @@ sys.path.insert(0, os.path.dirname(__file__))
 from test_bench_report import _stub_phases  # noqa: E402
 from test_raft_group_commit import Net, cmd, elect, make_member  # noqa: E402
 
+# Captured before any monkeypatching: the guard below needs the REAL
+# function after _stub_phases replaces the module attribute.
+_REAL_RAFT_OPEN_LOOP = bench.bench_raft_open_loop
+
 
 def _real_group_commit_stamp(tmp_path) -> dict:
     """Drive the actual commit pipeline once and return its raft stamp."""
@@ -51,6 +55,10 @@ def _burst_transport_stats() -> dict:
 def test_raft_bench_section_emits_replication_stamps(tmp_path, monkeypatch,
                                                      capsys):
     _stub_phases(monkeypatch)
+    # _stub_phases stubs bench_raft_open_loop for the report-shape tests;
+    # THIS guard exists to drive the real one (over a faked sweep), so put
+    # it back.
+    monkeypatch.setattr(bench, "bench_raft_open_loop", _REAL_RAFT_OPEN_LOOP)
     monkeypatch.setattr(bench, "_install_watchdog", lambda *a: None)
     # Degraded (host-only) path: no device phases, but the raft open-loop
     # config still measures — on the real bench_raft_open_loop. One init

@@ -20,6 +20,9 @@ def _stub_phases(monkeypatch):
     monkeypatch.setattr(bench, "bench_notary_roundtrip",
                         lambda **kw: {"tx_per_sec": 100.0})
     for name in ("bench_raft_cluster", "bench_open_loop_latency",
+                 "bench_raft_open_loop",  # unstubbed, this one ran a REAL
+                 # multiprocess raft sweep (and now a sidecar) inside every
+                 # report test — minutes of suite time measuring nothing
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -194,6 +197,95 @@ def test_warm_fault_degrades_to_host_only(monkeypatch, capsys):
     assert report["baseline_configs"]["flow_churn"] == {
         "stub": "bench_flow_churn"}
     assert report["value"] == 0.0  # no device headline: honest zero
+
+
+def _fake_multiprocess_result(sidecar=None, stamps=None):
+    from corda_tpu.tools.loadtest import MultiProcessResult
+
+    return MultiProcessResult(
+        tx_requested=8, tx_committed=8, tx_rejected=0, width=4, clients=2,
+        duration_s=1.0, wall_s=1.5, tx_per_sec=8.0, sigs_verified=32,
+        sigs_per_sec=32.0, p50_ms=5.0, p99_ms=9.0,
+        node_stamps=stamps if stamps is not None else {},
+        sidecar=sidecar)
+
+
+def test_raft_cluster_report_carries_sidecar_and_occupancy(monkeypatch):
+    """The one-line-JSON contract for the sidecar rollout: BOTH the
+    device-ish (sidecar=True) and the host-only default paths must emit
+    the sidecar + device_occupancy keys, so trend tooling never branches
+    on schema."""
+    from corda_tpu.tools import loadtest
+
+    server_stats = {"batches": 2, "sigs": 80, "cross_request_batches": 1,
+                    "batch_sigs_hist": {"256": 2}}
+    stamps = {"Raft0": {"device_batches": 3, "host_batches": 1},
+              "Raft1": {"device_batches": 0, "host_batches": 0}}
+    monkeypatch.setattr(
+        loadtest, "run_loadtest_multiprocess",
+        lambda **kw: _fake_multiprocess_result(
+            sidecar=server_stats if kw.get("sidecar") else None,
+            stamps=stamps))
+
+    dev = bench.bench_raft_cluster(n_tx=8, sidecar=True)
+    assert dev["sidecar"] == server_stats
+    assert dev["device_batches"] == 3
+    assert dev["host_batches"] == 1
+    assert dev["device_occupancy"] == 0.75
+
+    host = bench.bench_raft_cluster(n_tx=8)  # host-only default path
+    assert "sidecar" in host and host["sidecar"] is None
+    assert host["device_occupancy"] == 0.75  # same aggregation either way
+
+    # Zero batches anywhere: occupancy is an honest 0.0, never a crash.
+    monkeypatch.setattr(
+        loadtest, "run_loadtest_multiprocess",
+        lambda **kw: _fake_multiprocess_result(stamps={"Raft0": {}}))
+    empty = bench.bench_raft_cluster(n_tx=8)
+    assert empty["device_occupancy"] == 0.0
+    assert empty["sidecar"] is None
+
+
+def test_raft_open_loop_report_carries_sidecar_and_occupancy(monkeypatch):
+    import types
+
+    from corda_tpu.tools import loadtest
+
+    rate_result = types.SimpleNamespace(p50_ms=4.0, p90_ms=6.0, p99_ms=8.0,
+                                        tx_per_sec=30.0, committed=200)
+    server_stats = {"batches": 5, "sigs": 400}
+
+    def fake_sweep(**kw):
+        return loadtest.SweepResult(
+            results={30.0: rate_result},
+            node_stamps={"Raft0": {"device_batches": 4, "host_batches": 4}},
+            trace_snapshots=[],
+            sidecar=server_stats if kw.get("sidecar") else None)
+
+    monkeypatch.setattr(loadtest, "run_latency_sweep", fake_sweep)
+
+    dev = bench.bench_raft_open_loop(rates=(30.0,), n_tx=200, sidecar=True)
+    assert dev["sidecar"] == server_stats
+    assert dev["device_occupancy"] == 0.5
+    assert dev["rates"]["30_tx_s"]["p99_ms"] == 8.0
+
+    host = bench.bench_raft_open_loop(rates=(30.0,), n_tx=200)
+    assert "sidecar" in host and host["sidecar"] is None
+    assert "device_occupancy" in host
+
+
+def test_verifier_stamp_reports_device_occupancy():
+    class FakeVerifier:
+        name = "jax-batch"
+        device_min_sigs = 512
+        device_batches = 9
+        host_batches = 3
+
+    stamp = bench._verifier_stamp(FakeVerifier())
+    assert stamp["device_occupancy"] == 0.75
+    FakeVerifier.device_batches = 0
+    FakeVerifier.host_batches = 0
+    assert bench._verifier_stamp(FakeVerifier())["device_occupancy"] == 0.0
 
 
 def test_total_crash_still_prints_one_line(monkeypatch, capsys):
