@@ -217,6 +217,47 @@ def _resolve_member(flow: FlowLogic, legal_name: str) -> Party | None:
     return None
 
 
+def _shard_directory(flow: FlowLogic):
+    """Discover the sharded-notary topology from the network map: members
+    of shard group g advertise "corda.notary.shard.<g>of<n>", so the map
+    every party already syncs doubles as the shard directory. Returns
+    (count, {group: [Party, ...]}) or None when the notary is unsharded."""
+    from ..node.services.sharding import parse_shard_service
+
+    count = 0
+    groups: dict[int, list[Party]] = {}
+    try:
+        for info in flow.service_hub.network_map_cache.party_nodes:
+            for svc in info.advertised_services:
+                parsed = parse_shard_service(str(svc.type))
+                if parsed is not None:
+                    g, n = parsed
+                    count = max(count, n)
+                    groups.setdefault(g, []).append(info.legal_identity)
+    except Exception:
+        return None
+    if count <= 1 or not groups:
+        return None
+    for members in groups.values():
+        members.sort(key=lambda p: p.name)
+    return count, groups
+
+
+def _route_group(stx: SignedTransaction, directory) -> int | None:
+    """Owning group for routing: the first input's shard (for a
+    single-shard tx that IS the owning group — the fast path; for a
+    cross-shard tx it picks the coordinator deterministically). None when
+    unsharded or the tx has no inputs (an issuance commits anywhere)."""
+    if directory is None:
+        return None
+    inputs = stx.tx.inputs
+    if not inputs:
+        return None
+    from ..node.services.sharding import shard_of
+
+    return shard_of(inputs[0], directory[0])
+
+
 def _timer_poll(wake_at: float):
     """Non-blocking in-flow backoff: a ServiceRequest poll that stays
     pending until `wake_at` (time.monotonic). Sleeping in place would
@@ -249,6 +290,15 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
     that member via NotaryClientFlow(via=...) instead of re-traversing a
     redirect.
 
+    Leader hints are keyed PER GROUP: with a sharded notary there are N
+    independent Raft clusters, and a hint from one shard's deposed leader
+    names a member of THAT group only — applying it to a request routed at
+    another group would aim the retry at a node that is not even a member
+    of the deciding cluster. Sharded topologies are discovered from the
+    network map (see _shard_directory) and requests route to the owning
+    group of the tx's first input, so single-shard traffic lands on its
+    group's coordinator directly (the fast path).
+
     The load/bench tools (loadgen, loadtest, demo_cordapp) deliberately
     call NotaryClientFlow raw — retries there would mask the availability
     behaviour they exist to measure."""
@@ -257,8 +307,18 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
     deadline = None if deadline_s is None else _time.monotonic() + deadline_s
     attempt = 0
     backoff = backoff_s
-    via: Party | None = None
+    directory = _shard_directory(flow)
+    group = _route_group(stx, directory)
+    group_members = (frozenset(p.name for p in directory[1].get(group, ()))
+                     if directory is not None and group is not None else None)
+    # group id -> preferred member; None key = the unsharded single cluster.
+    hints: dict = {}
     while True:
+        via: Party | None = hints.get(group)
+        if via is None and group is not None:
+            members = directory[1].get(group)
+            if members:
+                via = members[0]
         notary_flow = NotaryClientFlow(stx, via=via)
         if on_attempt is not None:
             on_attempt(notary_flow)
@@ -274,7 +334,14 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
                 raise
             hint = getattr(e.error, "leader_hint", None)
             if hint:
-                via = _resolve_member(flow, hint) or via
+                resolved = _resolve_member(flow, hint)
+                # The hint redirects only the group THIS attempt was
+                # routed at; with a shard directory in hand, drop hints
+                # naming non-members of that group outright.
+                if resolved is not None and (
+                        group_members is None
+                        or resolved.name in group_members):
+                    hints[group] = resolved
             if backoff > 0:
                 wake_at = now + min(backoff, max_backoff_s)
                 if deadline is not None:

@@ -78,6 +78,23 @@ class RaftConfig:
 
 
 @dataclass(frozen=True)
+class ShardConfig:
+    """Sharded-notary topology (services/sharding.py).
+
+    The input-state space is partitioned by StateRef hash across `count`
+    independent Raft groups; `groups[g]` lists the member names of group g
+    (a member's own raft_cluster is exactly its group). Reservations taken
+    by the cross-shard two-phase coordinator expire `reserve_ttl_s` seconds
+    after the coordinator's issued_at stamp — judged stamp-vs-stamp in the
+    replicated state machine, never against a replica's local clock.
+    """
+
+    count: int = 1
+    groups: tuple[tuple[str, ...], ...] = ()
+    reserve_ttl_s: float = 15.0
+
+
+@dataclass(frozen=True)
 class NodeConfig:
     name: str
     base_dir: Path
@@ -95,6 +112,10 @@ class NodeConfig:
     verifier: str = "cpu"  # cpu | jax | jax-shadow | jax-sharded
     batch: BatchConfig = field(default_factory=BatchConfig)
     raft: RaftConfig = field(default_factory=RaftConfig)
+    # Sharded notary: when set (count > 1 or groups non-empty), this raft-*
+    # notary member is one shard of a partitioned uniqueness service and
+    # uses the ShardedUniquenessProvider two-phase coordinator.
+    notary_shards: ShardConfig | None = None
     # RPC users: ({"username","password","permissions": [flow names]|["ALL"]},)
     rpc_users: tuple = ()
     # CorDapp modules: imported at node start so their @register_flow /
@@ -116,7 +137,8 @@ class NodeConfig:
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
                  "network_map", "map_service", "map_node", "tls", "web_port",
-                 "verifier", "batch", "raft", "rpc_users", "cordapps"}
+                 "verifier", "batch", "raft", "rpc_users", "cordapps",
+                 "notary_shards"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -131,6 +153,21 @@ class NodeConfig:
         nm = raw.get("network_map")
         batch = raw.get("batch", {})
         raft = raw.get("raft", {})
+        shards_raw = raw.get("notary_shards")
+        shards = None
+        if shards_raw is not None:
+            groups = tuple(tuple(g) for g in shards_raw.get("groups", ()))
+            count = int(shards_raw.get("count", len(groups) or 1))
+            if groups and len(groups) != count:
+                raise ValueError(
+                    f"notary_shards: count={count} but {len(groups)} groups")
+            if not notary.startswith("raft"):
+                raise ValueError("notary_shards requires a raft-* notary")
+            shards = ShardConfig(
+                count=count,
+                groups=groups,
+                reserve_ttl_s=float(shards_raw.get("reserve_ttl_s", 15.0)),
+            )
         return NodeConfig(
             name=raw["name"],
             base_dir=base,
@@ -161,6 +198,7 @@ class NodeConfig:
                 pipeline_window=int(raft.get("pipeline_window", 1024)),
                 append_chunk=int(raft.get("append_chunk", 256)),
             ),
+            notary_shards=shards,
             rpc_users=tuple(
                 dict(u) for u in raw.get("rpc_users", ())),
             cordapps=tuple(raw.get("cordapps", ())),
@@ -199,26 +237,42 @@ def _encode_owning_key(key: CompositeKey) -> str:
 def netmap_register(path: str | os.PathLike, name: str, host: str, port: int,
                     owning_key: CompositeKey,
                     services: tuple[str, ...] = ()) -> None:
-    """Add/replace this node's entry (atomic file replace — last writer wins,
-    same-name entries collapse)."""
-    entries = netmap_load(path)
-    entries = [e for e in entries if e.name != name]
-    entries.append(NetMapEntry(name, host, port,
-                               _encode_owning_key(owning_key), tuple(services)))
-    payload = json.dumps([e.__dict__ | {"services": list(e.services)}
-                          for e in sorted(entries, key=lambda e: e.name)],
-                         indent=1)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)))
+    """Add/replace this node's entry (atomic file replace, same-name entries
+    collapse). The load-modify-replace runs under an flock on a sidecar
+    lock file: nodes in a cluster boot concurrently, and without the lock
+    two simultaneous registrations each read the map missing the other and
+    the second replace silently drops the first node's entry — that node
+    stays unreachable for its whole life (registration is boot-only; the
+    periodic refresh only reads)."""
+    lock = open(os.path.abspath(os.fspath(path)) + ".lock", "a")
     try:
-        with os.fdopen(fd, "w") as f:
-            f.write(payload)
-        os.replace(tmp, path)
-    except BaseException:
         try:
-            os.unlink(tmp)
-        except OSError:
+            import fcntl
+            fcntl.flock(lock, fcntl.LOCK_EX)
+        except ImportError:  # non-POSIX: keep the old last-writer-wins
             pass
-        raise
+        entries = netmap_load(path)
+        entries = [e for e in entries if e.name != name]
+        entries.append(NetMapEntry(name, host, port,
+                                   _encode_owning_key(owning_key),
+                                   tuple(services)))
+        payload = json.dumps([e.__dict__ | {"services": list(e.services)}
+                              for e in sorted(entries, key=lambda e: e.name)],
+                             indent=1)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)))
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    finally:
+        lock.close()  # closing the fd releases the flock
 
 
 def netmap_load(path: str | os.PathLike) -> list[NetMapEntry]:

@@ -33,6 +33,7 @@ from .services.api import (
     NodeInfo,
     ServiceHub,
     ServiceInfo,
+    ServiceType,
     SIMPLE_NOTARY,
     StorageService,
     VALIDATING_NOTARY,
@@ -111,6 +112,19 @@ class Node:
             services = (ServiceInfo(SIMPLE_NOTARY),)
         elif config.notary in ("validating", "raft-validating"):
             services = (ServiceInfo(VALIDATING_NOTARY),)
+        if config.notary_shards is not None:
+            # Shard members also advertise their group + the total shard
+            # count ("corda.notary.shard.<g>of<n>"): the netmap every party
+            # already syncs doubles as the shard directory, so clients
+            # recover the full shard map with zero extra round trips.
+            from .services.sharding import shard_service_string
+
+            my_group = next(
+                (g for g, members in enumerate(config.notary_shards.groups)
+                 if config.name in members), None)
+            if my_group is not None:
+                services += (ServiceInfo(ServiceType(shard_service_string(
+                    my_group, config.notary_shards.count))),)
         self.info = NodeInfo(
             address=self.messaging.my_address,
             legal_identity=self.identity,
@@ -185,6 +199,10 @@ class Node:
             lambda: self.refresh_netmap_maybe(every=0.25))
 
         # -- notary --------------------------------------------------------
+        # Name -> TcpAddress for every netmap entry (superset of raft
+        # peers); mutated in place by refresh_netmap so bound .get methods
+        # stay live.
+        self._netmap_addrs: dict = {}
         self.uniqueness_provider = None
         self.notary_service = None
         self.raft_member = None
@@ -204,8 +222,20 @@ class Node:
                     apply_command=make_apply_command(self.db),
                     config=config.raft,  # commit-pipeline policy ([raft])
                 )
-                self.uniqueness_provider = RaftUniquenessProvider(
-                    self.raft_member, pump=self._raft_pump)
+                # Cross-group reply routing (sharded notary): resolve ANY
+                # netmap member by name, not just this member's own peers,
+                # so a coordinator in another group gets its ClientReply
+                # back even though it is outside our raft_cluster.
+                self.raft_member.resolve_addr = self._netmap_addrs.get
+                if config.notary_shards is not None:
+                    from .services.sharding import ShardedUniquenessProvider
+
+                    self.uniqueness_provider = ShardedUniquenessProvider(
+                        self.raft_member, pump=self._raft_pump,
+                        shards=config.notary_shards)
+                else:
+                    self.uniqueness_provider = RaftUniquenessProvider(
+                        self.raft_member, pump=self._raft_pump)
             else:
                 self.uniqueness_provider = PersistentUniquenessProvider(self.db)
             cls = (ValidatingNotaryService
@@ -305,10 +335,22 @@ class Node:
         path = self.config.network_map
         if path is None:
             return
-        for entry in netmap_load(path):
+        entries = netmap_load(path)
+        # Self-heal: if our own row vanished (a concurrent boot clobbered
+        # the file before registration was flock-serialised, or an operator
+        # replaced the map), write it back — registration is otherwise
+        # boot-only, so a lost entry means no peer can ever reach us.
+        if self._started and all(e.name != self.config.name for e in entries):
+            netmap_register(
+                path, self.config.name, self.messaging.my_address.host,
+                self.messaging.my_address.port, self.identity.owning_key,
+                tuple(str(s.type) for s in self.info.advertised_services))
+            entries = netmap_load(path)
+        for entry in entries:
             info = entry.node_info()
             self.identity_service.register_identity(info.legal_identity)
             self.network_map_cache.add_node(info)
+            self._netmap_addrs[entry.name] = info.address
             if (self.raft_member is not None
                     and entry.name in self.config.raft_cluster
                     and entry.name != self.config.name):

@@ -220,6 +220,14 @@ class NodeRpcOps:
             "raft": (self._node.raft_member.stamp()
                      if getattr(self._node, "raft_member", None) is not None
                      else None),
+            # Sharded-notary coordinator stamps (services/sharding.py):
+            # fast-path vs cross-shard counts, aborts, reserve retries;
+            # None when this node is not a shard member.
+            "sharding": (self._node.uniqueness_provider.stamp()
+                         if hasattr(getattr(self._node,
+                                            "uniqueness_provider", None),
+                                    "stamp")
+                         else None),
             # Transport burst stamps (messaging/tcp.py): outbox executemany
             # bursts + bridge writev flushes; None on non-TCP fakes.
             "transport": (self._node.messaging.transport_stats()

@@ -69,6 +69,11 @@ CREATE TABLE IF NOT EXISTS raft_meta (
     term      INTEGER NOT NULL,
     voted_for TEXT
 );
+CREATE TABLE IF NOT EXISTS reserved_states (
+    state_ref  BLOB PRIMARY KEY,
+    tx_id      BLOB NOT NULL,
+    expires_at REAL NOT NULL
+);
 """
 
 
@@ -85,6 +90,61 @@ class PutAllCommand:
     tx_id: SecureHash
     caller: Party
     request_id: bytes  # correlates the client's reply
+    # Coordinator wall-clock stamp (epoch seconds), carried IN the command so
+    # every replica evaluates reservation expiry against the same value — the
+    # state machine never reads a local clock (replicas would diverge). A
+    # reservation with expires_at=E blocks a different-tx command iff its
+    # issued_at < E; issued_at >= E is a deterministic steal. Re-stamped on
+    # every resubmission (same request_id), so a command parked behind a
+    # crashed coordinator's reservation gets through once the TTL passes.
+    issued_at: float = 0.0
+
+
+@register
+@dataclass(frozen=True)
+class ReserveCommand:
+    """Phase 1 of the cross-shard two-phase commit (services/sharding.py):
+    claim a REVOCABLE hold on `refs` for tx_id. Applies atomically — every
+    ref free (or held/committed by the same tx) or none. Outcomes: ok
+    (reserved), conflict (some ref committed by another tx — final), or BUSY
+    (some ref reserved by another unexpired tx — retryable bounce). The hold
+    expires at issued_at + ttl_s, so a coordinator that dies between phases
+    never wedges inputs: expiry is decided from command-carried stamps, not
+    replica clocks (see PutAllCommand.issued_at)."""
+
+    refs: tuple
+    tx_id: SecureHash
+    caller: Party
+    request_id: bytes
+    issued_at: float
+    ttl_s: float
+
+
+@register
+@dataclass(frozen=True)
+class CommitReservedCommand:
+    """Phase 2 commit: promote tx_id's reservations on `refs` to durable
+    committed_states rows. Idempotent (already-committed-by-this-tx is ok);
+    conflicts only if another tx committed a ref first — a reservation lost
+    to TTL expiry does NOT block the commit, which is what guarantees phase
+    2 terminates (the steal window is documented in ARCHITECTURE.md)."""
+
+    refs: tuple
+    tx_id: SecureHash
+    caller: Party
+    request_id: bytes
+
+
+@register
+@dataclass(frozen=True)
+class AbortReservedCommand:
+    """Phase 2 abort: release tx_id's own reservations on `refs`. Always
+    succeeds (releasing nothing is fine) — abort must never add a failure
+    mode to a 2PC already unwinding."""
+
+    refs: tuple
+    tx_id: SecureHash
+    request_id: bytes
 
 
 @register
@@ -197,7 +257,11 @@ class InstallSnapshot:
     position (DistributedImmutableMap.kt snapshot/install capability).
     CHUNKED: large maps ship as an ordered series of frames (each well under
     the transport's frame cap); `offset` is the entry index of the first
-    entry in this chunk, `done` marks the last chunk."""
+    entry in this chunk, `done` marks the last chunk. Live reservations
+    (cross-shard 2PC holds) ride the final chunk only — the table is small
+    (in-flight 2PCs, not history), and a follower restored without them
+    could commit a PutAll straight through a hold the rest of the group is
+    honouring."""
 
     term: int
     leader: str
@@ -206,6 +270,7 @@ class InstallSnapshot:
     entries: tuple  # ((state_ref_blob, consuming_blob), ...)
     offset: int = 0
     done: bool = True
+    reservations: tuple = ()  # ((state_ref_blob, tx_id_bytes, expires_at),)
 
 
 @register
@@ -214,6 +279,23 @@ class InstallSnapshotReply:
     term: int
     follower: str
     last_included_index: int
+
+
+class _Busy:
+    """Third apply outcome beside None (ok) and UniquenessConflict (final):
+    the command lost to another transaction's UNEXPIRED reservation. Mapped
+    by _apply_committed to the retryable bounce reply form (ok=False,
+    conflict=None) that commit pollers already answer by resubmitting — the
+    resubmission carries a fresh issued_at, so it wins deterministically
+    once the hold expires, or resolves against the holder's commit/abort."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "BUSY"
+
+
+BUSY = _Busy()
 
 
 class RaftMember:
@@ -239,6 +321,12 @@ class RaftMember:
         self.config = config or RaftConfig()
         self.name = name
         self.peers = dict(peers)
+        # Cross-group reply routing (sharded notary): a 2PC coordinator in
+        # ANOTHER Raft group sends ClientCommit frames here with a reply_to
+        # that is not one of this group's peers. The node injects a netmap-
+        # backed name->address resolver so decisions find their way back;
+        # None keeps the single-group behaviour exactly (peers-only).
+        self.resolve_addr: Callable[[str], Any] | None = None
         self.messaging = messaging
         self.db = db
         self.apply_command = apply_command
@@ -578,8 +666,9 @@ class RaftMember:
             fwd = getattr(self, "_forward_replies", {}).pop(
                 cmd.request_id, None)
             reply = ClientReply(cmd.request_id, False, None, self.leader_name)
-            if fwd is not None and fwd in self.peers:
-                self._send(self.peers[fwd], reply)
+            addr = self._peer_addr(fwd)
+            if addr is not None:
+                self._send(addr, reply)
             else:
                 self._record_decision(cmd.request_id, reply)
         self._appending.clear()
@@ -650,6 +739,16 @@ class RaftMember:
                 command.request_id, False, None, self.leader_name)
 
     # -- message handling --------------------------------------------------
+
+    def _peer_addr(self, name: str | None):
+        """Transport address for a member name: this group's peers first,
+        then the injected netmap resolver (cross-group 2PC reply routing)."""
+        if name is None:
+            return None
+        addr = self.peers.get(name)
+        if addr is None and self.resolve_addr is not None:
+            addr = self.resolve_addr(name)
+        return addr
 
     def _send(self, to, payload) -> None:
         if _faults.ACTIVE is not None and isinstance(
@@ -782,14 +881,16 @@ class RaftMember:
                     # series every throttle window.
                     self._snapshot_sent_at[peer_name] = now
                     content = self._state_machine_content()
+                    reservations = self._reservation_content()
                     chunks = []
                     for off in range(0, max(len(content), 1),
                                      self.SNAPSHOT_CHUNK):
                         chunk = content[off:off + self.SNAPSHOT_CHUNK]
+                        done = off + self.SNAPSHOT_CHUNK >= len(content)
                         chunks.append(serialize(InstallSnapshot(
                             self.term, self.name, self.snapshot_index,
-                            self.snapshot_term, chunk, off,
-                            off + self.SNAPSHOT_CHUNK >= len(content))).bytes)
+                            self.snapshot_term, chunk, off, done,
+                            reservations if done else ())).bytes)
                     # The whole ordered series hits the durable outbox as
                     # one burst (one executemany/fsync, one bridge wakeup).
                     self._send_burst(addr, chunks)
@@ -844,6 +945,16 @@ class RaftMember:
         rows = self.db.conn.execute(
             "SELECT state_ref, consuming FROM committed_states").fetchall()
         return tuple((bytes(r[0]), bytes(r[1])) for r in rows)
+
+    def _reservation_content(self) -> tuple:
+        """Live 2PC holds — part of the replicated state (a snapshot-
+        installed follower without them would let a PutAll through a hold
+        the rest of the group honours). Small by construction: in-flight
+        reservations, not history."""
+        rows = self.db.conn.execute(
+            "SELECT state_ref, tx_id, expires_at FROM reserved_states"
+        ).fetchall()
+        return tuple((bytes(r[0]), bytes(r[1]), float(r[2])) for r in rows)
 
     def maybe_compact(self) -> None:
         """Drop applied log entries once the log outgrows the threshold —
@@ -915,6 +1026,12 @@ class RaftMember:
                     "INSERT OR REPLACE INTO committed_states "
                     "(state_ref, consuming) VALUES (?, ?)",
                     list(entries))
+                self.db.conn.execute("DELETE FROM reserved_states")
+                self.db.conn.executemany(
+                    "INSERT OR REPLACE INTO reserved_states "
+                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
+                    [(bytes(ref), bytes(tx), float(exp))
+                     for ref, tx, exp in snap.reservations])
                 self._entry_cache.clear()
                 self._blob_cache.clear()
                 self.db.conn.execute("DELETE FROM raft_log")
@@ -1023,8 +1140,10 @@ class RaftMember:
             self.submit(cc.command)
         else:
             # Not the leader anymore: bounce with a hint so the origin
-            # re-routes after its next ticks.
-            addr = self.peers.get(cc.reply_to)
+            # re-routes after its next ticks. The origin may live in ANOTHER
+            # Raft group (a cross-shard 2PC coordinator) — resolve beyond
+            # this group's peers.
+            addr = self._peer_addr(cc.reply_to)
             if addr is not None:
                 self._send(addr, ClientReply(
                     cc.command.request_id, False, None, self.leader_name))
@@ -1085,22 +1204,31 @@ class RaftMember:
                 # commit batch runs the first-committer-wins check on its
                 # own — one double-spend rejects alone, its batch siblings
                 # commit normally.
-                conflict = self.apply_command(cmd)
-                reply = ClientReply(cmd.request_id, conflict is None,
-                                    conflict, self.leader_name)
+                outcome = self.apply_command(cmd)
+                if outcome is BUSY:
+                    # Reserved by another unexpired 2PC: the retryable bounce
+                    # form (ok=False, conflict=None) — the submitting poller
+                    # resubmits with a fresh issued_at until the hold
+                    # resolves or expires.
+                    reply = ClientReply(cmd.request_id, False, None,
+                                        self.leader_name)
+                else:
+                    reply = ClientReply(cmd.request_id, outcome is None,
+                                        outcome, self.leader_name)
                 self._record_decision(cmd.request_id, reply)
                 self._appending.discard(cmd.request_id)
                 fwd = getattr(self, "_forward_replies", {}).pop(
                     cmd.request_id, None)
-                if fwd is not None and fwd in self.peers:
+                if fwd is not None and self._peer_addr(fwd) is not None:
                     outbound.setdefault(fwd, []).append(reply)
         for fwd, replies in outbound.items():
             self.metrics["reply_frames"] += 1
             self.metrics["reply_commands"] += len(replies)
             if len(replies) == 1:
-                self._send(self.peers[fwd], replies[0])
+                self._send(self._peer_addr(fwd), replies[0])
             else:
-                self._send(self.peers[fwd], ClientReplyBatch(tuple(replies)))
+                self._send(self._peer_addr(fwd),
+                           ClientReplyBatch(tuple(replies)))
         if applied_any:  # no idle-heartbeat sqlite churn
             self.db.set_setting("raft_commit_index", str(self.commit_index))
             self.db.set_setting("raft_last_applied", str(self.last_applied))
@@ -1117,10 +1245,22 @@ class RaftMember:
         commands = m["group_commands"] + m["solo_commits"]
         frames = m["reply_frames"]
         rtt_n = m["replication_rtt_n"]
+        (reserved,) = self.db.conn.execute(
+            "SELECT COUNT(*) FROM reserved_states").fetchone()
+        (committed,) = self.db.conn.execute(
+            "SELECT COUNT(*) FROM committed_states").fetchone()
         return {
             "role": self.role,
             "term": self.term,
             "commit_index": self.commit_index,
+            # Durable spent-input rows on THIS member — the ledger side of
+            # the cross-process exactly-once audit (each consumed ref is
+            # one row; loadtest sums max-over-members per shard group).
+            "committed_states": committed,
+            # Live 2PC holds — a drained workload must show 0 here (leaked
+            # reservations would mean wedged inputs; TTL abort is the
+            # backstop, this stamp is how audits see it worked).
+            "reserved_states": reserved,
             "group_commit": self.config.group_commit,
             "group_commits": m["group_commits"],
             "group_commands": m["group_commands"],
@@ -1182,12 +1322,14 @@ class RaftUniquenessProvider(UniquenessProvider):
     def commit_async(self, states: Sequence, tx_id: SecureHash,
                      caller_identity: Party) -> Callable[[], bool | None]:
         # Hot path: `os` is imported at module top (an import inside here
-        # paid a sys.modules lookup per notarisation), and the command is
-        # built ONCE — every RESUBMIT_EVERY re-offer reuses the same frozen
-        # PutAllCommand (same request_id: idempotent across leader changes).
+        # paid a sys.modules lookup per notarisation). The refs tuple is
+        # built ONCE; each RESUBMIT_EVERY re-offer re-stamps issued_at on
+        # the same request_id (idempotent across leader changes) — a frozen
+        # stamp would stay parked behind an expired reservation forever,
+        # because expiry is judged against the command's own stamp, never a
+        # replica clock (see PutAllCommand.issued_at).
         request_id = os.urandom(16)
-        command = PutAllCommand(tuple(states), tx_id, caller_identity,
-                                request_id)
+        refs = tuple(states)
         state = {"deadline": _time.monotonic() + self.timeout,
                  "submitted_at": 0.0}
         ctx = _obs.get_context() if _obs.ACTIVE is not None else None
@@ -1223,7 +1365,9 @@ class RaftUniquenessProvider(UniquenessProvider):
                     f"{self.timeout}s (leader: {self.member.leader_name})")
             if (state["submitted_at"] == 0.0
                     or now - state["submitted_at"] >= self.RESUBMIT_EVERY):
-                self.member.submit(command)
+                self.member.submit(PutAllCommand(
+                    refs, tx_id, caller_identity, request_id,
+                    issued_at=_time.time()))
                 state["submitted_at"] = now
             return None
 
@@ -1251,19 +1395,130 @@ class RaftUniquenessProvider(UniquenessProvider):
         return self.member.leader_name
 
 
-def make_apply_command(db) -> Callable[[PutAllCommand], UniquenessConflict | None]:
+def make_apply_command(db) -> Callable[[Any], Any]:
     """The replicated state machine's apply step: first-committer-wins over
-    the same committed_states table as the single-node provider. Idempotent
-    for re-applied entries (same tx claims same refs -> no conflict)."""
-    from .persistence import PersistentUniquenessProvider
+    the same committed_states table as the single-node provider, extended
+    with the cross-shard 2PC commands (Reserve / CommitReserved /
+    AbortReserved — services/sharding.py). Idempotent for re-applied entries
+    (same tx claims same refs -> no conflict).
 
-    single = PersistentUniquenessProvider(db)
+    Outcomes: None (ok), UniquenessConflict (final), BUSY (reserved by
+    another unexpired tx — retryable). DETERMINISM INVARIANT: every branch
+    below depends only on the command's own fields and replicated table
+    state — never on a local clock — so replicas applying the same log
+    prefix always agree (reservation expiry compares the command's
+    issued_at stamp against the stored expires_at)."""
+    with db.lock:
+        # The member normally creates this table, but apply closures are
+        # built before RaftMember.__init__ runs its schema script.
+        db.conn.executescript(_RAFT_SCHEMA)
+        db.conn.commit()
 
-    def apply(cmd: PutAllCommand) -> UniquenessConflict | None:
-        try:
-            single.commit(list(cmd.refs), cmd.tx_id, cmd.caller)
+    def _committed_conflicts(conn, refs, tx_id) -> dict:
+        conflicts = {}
+        for ref in refs:
+            row = conn.execute(
+                "SELECT consuming FROM committed_states WHERE state_ref = ?",
+                (serialize(ref).bytes,)).fetchone()
+            if row is not None:
+                consuming = deserialize(bytes(row[0]))
+                if consuming.id != tx_id:
+                    conflicts[ref] = consuming
+        return conflicts
+
+    def _blocked_by_reservation(conn, refs, tx_id, issued_at) -> bool:
+        """True iff some ref is held by a DIFFERENT tx whose hold has not
+        expired relative to this command's stamp (issued_at < expires_at;
+        issued_at >= expires_at is the deterministic steal)."""
+        for ref in refs:
+            row = conn.execute(
+                "SELECT tx_id, expires_at FROM reserved_states "
+                "WHERE state_ref = ?", (serialize(ref).bytes,)).fetchone()
+            if row is not None and bytes(row[0]) != tx_id.bytes \
+                    and issued_at < float(row[1]):
+                return True
+        return False
+
+    def _apply_put_all(cmd: PutAllCommand):
+        with db.lock:
+            conn = db.conn
+            conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
+            if conflicts:
+                return UniquenessConflict(conflicts)
+            if _blocked_by_reservation(conn, cmd.refs, cmd.tx_id,
+                                       cmd.issued_at):
+                return BUSY
+            for i, ref in enumerate(cmd.refs):
+                blob = serialize(ref).bytes
+                conn.execute(
+                    "INSERT OR IGNORE INTO committed_states "
+                    "(state_ref, consuming) VALUES (?, ?)",
+                    (blob, serialize(
+                        ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes))
+                # Clear any hold the commit supersedes (our own retried
+                # reserve, or an expired one we just stole past).
+                conn.execute(
+                    "DELETE FROM reserved_states WHERE state_ref = ?",
+                    (blob,))
+            db.commit()
             return None
-        except UniquenessException as e:
-            return e.error
+
+    def _apply_reserve(cmd: ReserveCommand):
+        with db.lock:
+            conn = db.conn
+            conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
+            if conflicts:
+                return UniquenessConflict(conflicts)
+            if _blocked_by_reservation(conn, cmd.refs, cmd.tx_id,
+                                       cmd.issued_at):
+                return BUSY
+            expires = cmd.issued_at + cmd.ttl_s
+            for ref in cmd.refs:
+                # REPLACE: refreshes our own hold on a retried reserve and
+                # deterministically steals an expired foreign one.
+                conn.execute(
+                    "INSERT OR REPLACE INTO reserved_states "
+                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
+                    (serialize(ref).bytes, cmd.tx_id.bytes, expires))
+            db.commit()
+            return None
+
+    def _apply_commit_reserved(cmd: CommitReservedCommand):
+        with db.lock:
+            conn = db.conn
+            conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
+            if conflicts:
+                return UniquenessConflict(conflicts)
+            for i, ref in enumerate(cmd.refs):
+                blob = serialize(ref).bytes
+                conn.execute(
+                    "INSERT OR IGNORE INTO committed_states "
+                    "(state_ref, consuming) VALUES (?, ?)",
+                    (blob, serialize(
+                        ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes))
+                conn.execute(
+                    "DELETE FROM reserved_states WHERE state_ref = ?",
+                    (blob,))
+            db.commit()
+            return None
+
+    def _apply_abort(cmd: AbortReservedCommand):
+        with db.lock:
+            for ref in cmd.refs:
+                db.conn.execute(
+                    "DELETE FROM reserved_states "
+                    "WHERE state_ref = ? AND tx_id = ?",
+                    (serialize(ref).bytes, cmd.tx_id.bytes))
+            db.commit()
+            return None
+
+    def apply(cmd):
+        if isinstance(cmd, ReserveCommand):
+            return _apply_reserve(cmd)
+        if isinstance(cmd, CommitReservedCommand):
+            return _apply_commit_reserved(cmd)
+        if isinstance(cmd, AbortReservedCommand):
+            return _apply_abort(cmd)
+        return _apply_put_all(cmd)
 
     return apply

@@ -1,0 +1,403 @@
+"""Sharded notary: StateRef-partitioned uniqueness over N Raft groups.
+
+One Raft group caps committed tx/s no matter how fast the verify plane gets
+(ROADMAP item 3). This subsystem partitions the input-state space by
+StateRef hash across N independent Raft groups — each group runs the full
+PR 2 machinery (group commit, pipelined replication, coalesced frames)
+over its own slice of the keyspace, so committed throughput scales with
+shard count.
+
+Shard map
+---------
+``shard_of(ref, n)`` is a pure function of the StateRef alone: the first 8
+bytes of the ref's txhash (already uniform — it is a SHA-256 Merkle root)
+XOR the output index, mod n. Every party computes it locally; the only
+shared datum is the shard COUNT, which rides the netmap as one advertised
+service string per shard member (``corda.notary.shard.<g>``), so clients
+build the directory from the network map they already have.
+
+Commit protocol
+---------------
+* Single-shard transaction whose owning group is the local member's group:
+  the exact RaftUniquenessProvider path — same PutAllCommand, same group
+  commit, same reply protocol. Semantics identical to the unsharded notary.
+* Otherwise, a two-phase coordinator drives the owning groups:
+
+    phase 1  ReserveCommand on every touched group, acquired strictly in
+             sorted group order (the next group only once the previous
+             hold is in hand — lock ordering, so live coordinators
+             contending on the same groups serialize instead of
+             deadlocking half-holds). Atomic per group: every input free
+             (or held/committed by this tx) or none; rejected with a
+             final conflict if committed by another tx, bounced BUSY if
+             held by another unexpired 2PC.
+    phase 2  all reserved -> CommitReservedCommand everywhere;
+             any conflict  -> AbortReservedCommand everywhere (best effort)
+             and the conflict surfaces to the caller.
+
+  Reservations carry a TTL stamped by the coordinator (issued_at + ttl_s),
+  and expiry is judged by comparing OTHER commands' issued_at stamps
+  against it — replicas never consult a local clock, so the state machines
+  cannot diverge (node/services/raft.py make_apply_command). A coordinator
+  that crashes between phases therefore never wedges inputs: its holds
+  become steals for any later command stamped past the expiry.
+
+  A retried 2PC for the same tx_id converges: reserve treats
+  committed-by-this-tx as success and CommitReserved is idempotent, so
+  exactly-once holds across coordinator retries, keyed on tx_id — the same
+  invariant the single-group path gets from first-committer-wins.
+
+Cross-group transport rides the existing Raft client channel: the
+coordinator sends ClientCommit(command, reply_to=<my member name>) to a
+member of the target group, and decisions come back as ClientReply frames
+into the local member's ``decided`` mailbox. The member resolves reply
+addresses beyond its own peers through the netmap resolver the node
+injects (RaftMember.resolve_addr).
+
+Failure matrix: ARCHITECTURE.md "Sharded notary (round 9)".
+"""
+
+from __future__ import annotations
+
+import os
+import time as _time
+from typing import Any, Callable, Sequence
+
+from ...crypto.hashes import SecureHash
+from ...crypto.party import Party
+from ...obs import trace as _obs
+from .api import UniquenessException, UniquenessProvider
+from .raft import (
+    AbortReservedCommand,
+    ClientCommit,
+    CommitReservedCommand,
+    CommitTimeoutException,
+    PutAllCommand,
+    RaftMember,
+    RaftUniquenessProvider,
+    ReserveCommand,
+)
+
+# Netmap service-string prefix: member of shard group g advertises
+# f"{SHARD_SERVICE_PREFIX}{g}of{count}" so clients recover both the group
+# id and the total shard count from the directory they already sync.
+SHARD_SERVICE_PREFIX = "corda.notary.shard."
+
+
+def shard_of(ref, count: int) -> int:
+    """Owning group of a StateRef — a pure function every party computes
+    identically from the ref alone (txhash is a SHA-256 Merkle root, so the
+    leading 8 bytes are already uniform; XOR the index so the outputs of
+    one transaction spread instead of clustering on one group)."""
+    if count <= 1:
+        return 0
+    return (int.from_bytes(ref.txhash.bytes[:8], "big") ^ ref.index) % count
+
+
+def split_by_shard(refs, count: int) -> dict[int, tuple]:
+    """Group refs by owning shard, preserving order within each group."""
+    by_group: dict[int, list] = {}
+    for ref in refs:
+        by_group.setdefault(shard_of(ref, count), []).append(ref)
+    return {g: tuple(v) for g, v in by_group.items()}
+
+
+def shard_service_string(group: int, count: int) -> str:
+    return f"{SHARD_SERVICE_PREFIX}{group}of{count}"
+
+
+def parse_shard_service(service: str) -> tuple[int, int] | None:
+    """(group, count) from an advertised service string, else None."""
+    if not service.startswith(SHARD_SERVICE_PREFIX):
+        return None
+    tail = service[len(SHARD_SERVICE_PREFIX):]
+    group_s, _, count_s = tail.partition("of")
+    try:
+        group, count = int(group_s), int(count_s)
+    except ValueError:
+        return None
+    if count <= 0 or not 0 <= group < count:
+        return None
+    return group, count
+
+
+class ShardedUniquenessProvider(UniquenessProvider):
+    """RaftUniquenessProvider-compatible facade over N Raft groups.
+
+    The local member belongs to exactly ONE group (its raft_cluster); this
+    provider routes single-shard traffic for that group straight through
+    the plain provider and coordinates the two-phase protocol for
+    everything else. Poll-driven like commit_async everywhere else in the
+    framework: the returned callable is parked on a ServiceRequest and the
+    node's run loop drives it, so the notary flow never blocks the message
+    pump that consensus (and the cross-group channel) rides on.
+    """
+
+    RESUBMIT_EVERY = 0.5  # sec; matches RaftUniquenessProvider pacing
+
+    def __init__(self, member: RaftMember, pump: Callable[[], None],
+                 shards, timeout: float = 25.0):
+        self.member = member
+        self._pump = pump
+        self.timeout = timeout
+        self._local = RaftUniquenessProvider(member, pump, timeout)
+        self.count = int(shards.count)
+        self.groups = tuple(tuple(g) for g in shards.groups)
+        self.ttl_s = float(shards.reserve_ttl_s)
+        self.my_group = next(
+            (i for i, g in enumerate(self.groups) if member.name in g), None)
+        # Per-group preferred target member for the cross-group channel:
+        # starts at the group's first member, follows leader hints from
+        # bounce replies (satellite-1 semantics: hints are PER GROUP — a
+        # deposed leader's hint from group 0 never redirects group 1).
+        self._targets: dict[int, str] = {
+            g: members[0] for g, members in enumerate(self.groups) if members}
+        self.metrics = {
+            "single_shard": 0,    # fast-path commits routed locally
+            "cross_shard": 0,     # two-phase coordinations started
+            "remote_single": 0,   # single-group txs owned by another group
+            "aborts_sent": 0,     # phase-1 failures unwound
+            "reserve_retries": 0,  # busy/leaderless resubmissions, phase 1
+        }
+
+    # -- commit ------------------------------------------------------------
+
+    def commit_async(self, states: Sequence, tx_id: SecureHash,
+                     caller_identity: Party) -> Callable[[], bool | None]:
+        refs = tuple(states)
+        by_group = split_by_shard(refs, self.count)
+        touched = set(by_group)
+        if not touched or touched == {self.my_group}:
+            # Fast path: everything this member's own group owns — the
+            # exact unsharded protocol, byte-identical commands.
+            self.metrics["single_shard"] += 1
+            return self._local.commit_async(refs, tx_id, caller_identity)
+        if len(touched) == 1:
+            # Single foreign group: no atomicity to coordinate — one remote
+            # PutAll through the cross-group channel (a 2PC would add a
+            # round trip for nothing).
+            self.metrics["remote_single"] += 1
+            return self._remote_put_poll(next(iter(touched)),
+                                         refs, tx_id, caller_identity)
+        self.metrics["cross_shard"] += 1
+        return self._two_phase_poll(by_group, tx_id, caller_identity)
+
+    def commit(self, states: Sequence, tx_id: SecureHash,
+               caller_identity: Party) -> None:
+        poll = self.commit_async(states, tx_id, caller_identity)
+        while True:
+            outcome = poll()
+            if outcome is not None:
+                return
+            self._pump()
+
+    # -- op plumbing -------------------------------------------------------
+
+    def _new_op(self, group: int) -> dict:
+        return {"group": group, "rid": os.urandom(16), "submitted_at": 0.0,
+                "done": False, "conflict": None}
+
+    def _dispatch(self, op: dict, command) -> None:
+        """Send one command toward its owning group: local group submits to
+        the local member (the ordinary follower-forwarding path applies);
+        remote groups get a ClientCommit frame addressed to the tracked
+        target member, replies landing in the local member's mailbox."""
+        if op["group"] == self.my_group:
+            self.member.submit(command)
+            return
+        target = self._targets.get(op["group"])
+        addr = self.member._peer_addr(target)
+        if addr is None:
+            # Target not resolvable yet (netmap lag): leave submitted_at so
+            # the pacing loop retries; the periodic netmap refresh fills
+            # the resolver.
+            return
+        self.member._send(addr, ClientCommit(command, self.member.name))
+
+    def _poll_op(self, op: dict, make_command, now: float) -> None:
+        """Advance one outstanding command: consume a decision if present,
+        otherwise (re)submit on the RESUBMIT_EVERY pace with a fresh
+        issued_at stamp (same rid — idempotent through leader changes and
+        deterministic against reservation expiry)."""
+        if op["done"] or op["conflict"] is not None:
+            return
+        reply = self.member.decided.pop(op["rid"], None)
+        if reply is not None:
+            if reply.ok:
+                op["done"] = True
+                return
+            if reply.conflict is not None:
+                op["conflict"] = reply.conflict
+                return
+            # Busy hold or leaderless bounce: follow the hint WITHIN this
+            # group only, and let the pacing below resubmit.
+            hint = reply.leader_hint
+            if hint and hint in self.groups[op["group"]]:
+                self._targets[op["group"]] = hint
+            op["retries"] = op.get("retries", 0) + 1
+        if (op["submitted_at"] == 0.0
+                or now - op["submitted_at"] >= self.RESUBMIT_EVERY):
+            self._dispatch(op, make_command(op))
+            op["submitted_at"] = now
+
+    def _send_aborts(self, by_group: dict[int, tuple], tx_id) -> None:
+        """Best-effort unwind: one AbortReservedCommand per touched group.
+        Fire-and-forget — a lost abort is exactly the crashed-coordinator
+        case, and the reservation TTL releases the holds deterministically."""
+        self.metrics["aborts_sent"] += 1
+        for group, refs in by_group.items():
+            op = self._new_op(group)
+            self._dispatch(op, AbortReservedCommand(refs, tx_id,
+                                                    op["rid"]))
+
+    # -- poll machines -----------------------------------------------------
+
+    def _remote_put_poll(self, group: int, refs, tx_id, caller):
+        op = self._new_op(group)
+        deadline = _time.monotonic() + self.timeout
+        ctx = _obs.get_context() if _obs.ACTIVE is not None else None
+        if ctx is not None:
+            _obs.register_link(op["rid"], ctx[0], ctx[1])
+            t0 = _obs.now()
+
+        def make_command(op):
+            return PutAllCommand(refs, tx_id, caller, op["rid"],
+                                 issued_at=_time.time())
+
+        def poll():
+            now = _time.monotonic()
+            self._poll_op(op, make_command, now)
+            if op["conflict"] is not None:
+                raise UniquenessException(op["conflict"])
+            if op["done"]:
+                if ctx is not None and _obs.ACTIVE is not None:
+                    _obs.record("raft_commit", t0, _obs.now(),
+                                trace_id=ctx[0], parent=ctx[1],
+                                attrs={"ok": True, "remote_group": group})
+                    _obs.pop_link(op["rid"])
+                return True
+            if now >= deadline:
+                raise CommitTimeoutException(
+                    f"remote shard {group} did not decide {tx_id} within "
+                    f"{self.timeout}s (target: {self._targets.get(group)})")
+            return None
+
+        return poll
+
+    def _two_phase_poll(self, by_group: dict[int, tuple], tx_id, caller):
+        groups = sorted(by_group)
+        deadline = _time.monotonic() + self.timeout
+        ctx = _obs.get_context() if _obs.ACTIVE is not None else None
+        state = {
+            "phase": "reserve",
+            "ops": {g: self._new_op(g) for g in groups},
+            "t_phase": _obs.now() if ctx is not None else 0.0,
+        }
+        if ctx is not None:
+            for op in state["ops"].values():
+                _obs.register_link(op["rid"], ctx[0], ctx[1])
+
+        def reserve_command(op):
+            return ReserveCommand(by_group[op["group"]], tx_id, caller,
+                                  op["rid"], issued_at=_time.time(),
+                                  ttl_s=self.ttl_s)
+
+        def commit_command(op):
+            return CommitReservedCommand(by_group[op["group"]], tx_id,
+                                         caller, op["rid"])
+
+        def _record_phase(name: str) -> None:
+            if ctx is not None and _obs.ACTIVE is not None:
+                _obs.record(name, state["t_phase"], _obs.now(),
+                            trace_id=ctx[0], parent=ctx[1],
+                            attrs={"groups": len(groups)})
+                state["t_phase"] = _obs.now()
+
+        def poll():
+            now = _time.monotonic()
+            make = (reserve_command if state["phase"] == "reserve"
+                    else commit_command)
+            if state["phase"] == "reserve":
+                # ORDERED acquisition: groups reserve strictly in sorted
+                # order, the next group only after the previous hold is in
+                # hand. Two live coordinators contending on the same groups
+                # therefore serialize at the lowest contended group instead
+                # of deadlocking half-holds against each other until both
+                # TTL-steal simultaneously (a partial-commit window). Costs
+                # one group RTT per extra group in phase 1; the TTL remains
+                # the backstop for CRASHED coordinators only.
+                for g in groups:
+                    op = state["ops"][g]
+                    before = op.get("retries", 0)
+                    self._poll_op(op, make, now)
+                    self.metrics["reserve_retries"] += (
+                        op.get("retries", 0) - before)
+                    if not op["done"] and op["conflict"] is None:
+                        break
+            else:
+                for op in state["ops"].values():
+                    self._poll_op(op, make, now)
+            conflict = next((op["conflict"]
+                             for op in state["ops"].values()
+                             if op["conflict"] is not None), None)
+            if conflict is not None:
+                if state["phase"] == "reserve":
+                    # Some input is finally spent elsewhere: release every
+                    # hold this attempt may have taken, then surface the
+                    # conflict (final — the client sees a double-spend).
+                    self._send_aborts(by_group, tx_id)
+                _record_phase("shard_reserve" if state["phase"] == "reserve"
+                              else "shard_commit")
+                raise UniquenessException(conflict)
+            if all(op["done"] for op in state["ops"].values()):
+                if state["phase"] == "reserve":
+                    _record_phase("shard_reserve")
+                    state["phase"] = "commit"
+                    state["ops"] = {g: self._new_op(g) for g in groups}
+                    if ctx is not None:
+                        for op in state["ops"].values():
+                            _obs.register_link(op["rid"], ctx[0], ctx[1])
+                    return None
+                _record_phase("shard_commit")
+                return True
+            if now >= deadline:
+                if state["phase"] == "reserve":
+                    # Could not assemble the full reservation set in time:
+                    # unwind (best effort; TTL is the deterministic
+                    # backstop) and report retryable unavailability.
+                    self._send_aborts(by_group, tx_id)
+                # Phase 2 deadline: do NOT abort — some groups may already
+                # have committed, and a retry of the same tx_id converges to
+                # the full commit (reserve/commit are idempotent per tx).
+                raise CommitTimeoutException(
+                    f"cross-shard {state['phase']} of {tx_id} over groups "
+                    f"{groups} not decided within {self.timeout}s")
+            return None
+
+        return poll
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def committed_count(self) -> int:
+        (n,) = self.member.db.conn.execute(
+            "SELECT COUNT(*) FROM committed_states").fetchone()
+        return n
+
+    def leader_hint(self) -> str | None:
+        """The LOCAL group's believed leader (NotaryUnavailable replies are
+        answered by a member of one group; its hint must only ever redirect
+        clients within that group — flows/notary.py keys hints per group)."""
+        return self.member.leader_name
+
+    def stamp(self) -> dict:
+        m = self.metrics
+        return {
+            "shards": self.count,
+            "my_group": self.my_group,
+            "single_shard": m["single_shard"],
+            "remote_single": m["remote_single"],
+            "cross_shard": m["cross_shard"],
+            "aborts_sent": m["aborts_sent"],
+            "reserve_retries": m["reserve_retries"],
+        }

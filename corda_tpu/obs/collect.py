@@ -36,11 +36,14 @@ import json
 # batches: server-side coalesce wait vs verify wall (crypto/sidecar.py).
 BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
                 "sidecar_verify", "raft_append", "fsync", "replication")
-# Per-trace measured stage spans.
-DIRECT_STAGES = ("verify_wait",)
+# Per-trace measured stage spans. shard_reserve/shard_commit are the two
+# phases of the cross-shard 2PC coordinator (services/sharding.py),
+# recorded on the coordinating notary against the client's trace.
+DIRECT_STAGES = ("verify_wait", "shard_reserve", "shard_commit")
 # Full breakdown order (reply is derived).
 STAGES = ("queue_wait", "verify_wait", "device_verify", "sidecar_wait",
-          "sidecar_verify", "raft_append", "fsync", "replication", "reply")
+          "sidecar_verify", "shard_reserve", "shard_commit",
+          "raft_append", "fsync", "replication", "reply")
 
 
 def _spans_of(snapshot) -> list[dict]:
@@ -209,10 +212,13 @@ def stage_breakdown(snapshots) -> dict:
         # this approaches 1.0 as instrumentation coverage improves).
         # sidecar_wait/sidecar_verify DECOMPOSE device_verify (same wall
         # window), so they stay out of the sum — counting them would push
-        # coverage past 1.0 whenever the sidecar is active.
+        # coverage past 1.0 whenever the sidecar is active. Same for
+        # shard_reserve/shard_commit: the 2PC phases wrap the underlying
+        # per-group raft stages, not extend them.
         "stage_sum_over_e2e": (
             (sum(v["mean_ms"] for k, v in stages_out.items()
-                 if k not in ("sidecar_wait", "sidecar_verify"))
+                 if k not in ("sidecar_wait", "sidecar_verify",
+                              "shard_reserve", "shard_commit"))
              / max(1e-9, summarize(end_to_end)["mean_ms"]))
             if end_to_end else 0.0),
     }

@@ -314,6 +314,22 @@ def render_node_config(name: str, node_dir, netmap, notary: str = "none",
     return "\n".join(lines) + "\n"
 
 
+def shard_groups_toml(groups, reserve_ttl_s: float = 15.0) -> str:
+    """The `[notary_shards]` fragment for a sharded-notary topology
+    (services/sharding.py): identical text for every member — each node
+    derives its own group from its own name. `groups` is a sequence of
+    member-name sequences, index = shard id. NOTE: this opens a TOML table,
+    so when composing extra_toml put this fragment LAST among bare keys
+    (the same ordering rule render_node_config applies to [[rpc_users]])."""
+    rows = ",\n  ".join(
+        "[" + ", ".join(_toml_escape(str(m)) for m in g) + "]"
+        for g in groups)
+    return ("[notary_shards]\n"
+            f"count = {len(list(groups))}\n"
+            f"reserve_ttl_s = {_toml_escape(float(reserve_ttl_s))}\n"
+            "groups = [\n  " + rows + ",\n]")
+
+
 def _node_env(device: str) -> dict:
     """Per-node device policy (the production topology: only the notary
     process owns the accelerator; every other child stays on the host
@@ -393,6 +409,44 @@ class Driver:
         if wait:
             handle.wait_up()
         return handle
+
+    def start_shard_cluster(self, groups: int = 2, members: int = 3,
+                            notary: str = "raft-simple",
+                            reserve_ttl_s: float = 15.0,
+                            extra_toml: str = "",
+                            cordapps: tuple[str, ...] = (),
+                            rpc: bool = False,
+                            device_member: tuple[int, int] | None = None,
+                            env_extra: dict | None = None,
+                            wait: bool = True,
+                            prefix: str = "Shard") -> list:
+        """Boot a sharded notary: `groups` independent Raft groups of
+        `members` nodes each (names Shard0A, Shard0B, ... Shard1A, ...),
+        every member carrying the same [notary_shards] map so each derives
+        its group from its own name. Returns handles indexed
+        [group][member]. `device_member` names the single (group, member)
+        that owns the accelerator (production placement: one chip, one
+        process); everyone else stays on the host path."""
+        names = [[f"{prefix}{g}{chr(ord('A') + m)}" for m in range(members)]
+                 for g in range(groups)]
+        shard_toml = shard_groups_toml(names, reserve_ttl_s)
+        merged = (extra_toml + "\n" + shard_toml) if extra_toml else shard_toml
+        handles = []
+        for g, group_names in enumerate(names):
+            row = []
+            for m, name in enumerate(group_names):
+                device = ("accelerator" if device_member == (g, m) else "cpu")
+                row.append(self.start_node(
+                    name, notary=notary, raft_cluster=tuple(group_names),
+                    cordapps=cordapps, rpc=rpc,
+                    wait=False, extra_toml=merged, device=device,
+                    env_extra=env_extra))
+            handles.append(row)
+        if wait:
+            for row in handles:
+                for h in row:
+                    h.wait_up()
+        return handles
 
     _SIDECAR_ARGV = [sys.executable, "-m", "corda_tpu.crypto.sidecar"]
 

@@ -63,6 +63,11 @@ class FirehoseResult:
     p99_ms: float
     width: int
     sigs_signed: int
+    # Sharded-notary mix accounting: how many of the requested transactions
+    # were generated with inputs spanning two shards, and how many of those
+    # committed (the exactly-once audit needs both sides of the ratio).
+    cross_requested: int = 0
+    cross_committed: int = 0
 
 
 class _Firehose:
@@ -89,14 +94,29 @@ class _Firehose:
         # starts (NotaryDemo semantics — issuance/signing is workload setup;
         # the measured quantity is the notarisation pipeline). Chunked so
         # the node keeps servicing its run loop while preparing.
-        self.corpus: list = []
+        self.corpus: list = []  # (stx, via_party_or_None, is_cross)
         self.started = 0
         self.done = 0
         self.committed = 0
         self.rejected = 0
+        self.cross_requested = 0
+        self.cross_committed = 0
         self.sigs_signed = 0
         self.latencies: list[float] = []
         self.t0: float | None = None  # set when the measured phase begins
+        # Sharded topology (if any) from the netmap: routes each move to
+        # its owning group's first member so single-shard traffic takes the
+        # fast path (without this every request lands on one arbitrary
+        # member and most commits cross groups — shard scaling would
+        # measure the coordinator, not the shards).
+        from ..flows.notary import _shard_directory
+
+        self.directory = _shard_directory(flow)
+        # Every Nth corpus transaction spans two shards (0 = none). With no
+        # shard directory the "cross" txs still carry two inputs — the
+        # same tx shape through an unsharded notary.
+        frac = getattr(flow, "cross_frac", 0.0)
+        self._cross_every = round(1.0 / frac) if frac > 0.0 else 0
 
     @staticmethod
     def _find_notary(hub):
@@ -105,27 +125,61 @@ class _Firehose:
             raise RuntimeError("no notary advertised in the network map")
         return notary
 
-    def _build_one(self, i: int):
-        """Issue (recorded locally, as in NotaryDemo) + signed move."""
+    def _issue_one(self, marker: int):
+        """One recorded issuance; returns its output ref's StateRef."""
         issue = TransactionBuilder(notary=self.notary)
         issue.add_output_state(
-            DummyMultiOwnerState(i, self.owners))
+            DummyMultiOwnerState(marker, self.owners))
         issue.add_command(Command(DummyCreate(),
                                   (self.issuer.public.composite,)))
         issue.sign_with(self.issuer)
         self.sigs_signed += 1
         issue_stx = issue.to_signed_transaction()
         self.flow.record_transactions([issue_stx])  # with provenance
+        return issue_stx.tx.out_ref(0)
+
+    def _route(self, state_and_ref):
+        """Member Party of the shard group owning a StateAndRef's ref
+        (None when the notary is unsharded)."""
+        if self.directory is None:
+            return None
+        from ..node.services.sharding import shard_of
+
+        count, groups = self.directory
+        members = groups.get(shard_of(state_and_ref.ref, count))
+        return members[0] if members else None
+
+    def _build_one(self, i: int):
+        """Issue (recorded locally, as in NotaryDemo) + signed move. Every
+        `_cross_every`-th move consumes TWO issued states owned by
+        DIFFERENT shards (re-issuing with a varied marker until the second
+        ref hashes into another group), forcing the 2PC path."""
+        cross = bool(self._cross_every) and i % self._cross_every == 0
+        refs = [self._issue_one(i * 1_000_003)]
+        if cross:
+            self.cross_requested += 1
+            for attempt in range(1, 17):
+                ref2 = self._issue_one(i * 1_000_003 + attempt)
+                if self.directory is None:
+                    break
+                from ..node.services.sharding import shard_of
+
+                count = self.directory[0]
+                if shard_of(ref2.ref, count) != shard_of(refs[0].ref, count):
+                    break  # spans two groups (expected ~n/(n-1) tries)
+            refs.append(ref2)
 
         move = TransactionBuilder(notary=self.notary)
-        move.add_input_state(issue_stx.tx.out_ref(0))
+        for ref in refs:
+            move.add_input_state(ref)
         move.add_command(Command(DummyMove(), self.owners))
         move.add_output_state(
             DummyMultiOwnerState(i, self.owners))
         for key in self.keys:
             move.sign_with(key)
         self.sigs_signed += len(self.keys)
-        return move.to_signed_transaction(check_sufficient_signatures=False)
+        stx = move.to_signed_transaction(check_sufficient_signatures=False)
+        return stx, self._route(refs[0]), cross
 
     def _admit_quota(self) -> int:
         """How many new flows this round may start."""
@@ -151,16 +205,18 @@ class _Firehose:
         if self.t0 is None:
             self.t0 = time.perf_counter()
         for _ in range(self._admit_quota()):
-            stx = self.corpus[self.started]
+            stx, via, cross = self.corpus[self.started]
             self.started += 1
             submitted = time.perf_counter()
-            handle = self.smm.add(NotaryClientFlow(stx))
+            handle = self.smm.add(NotaryClientFlow(stx, via=via))
 
-            def on_done(future, t=submitted):
+            def on_done(future, t=submitted, cross=cross):
                 self.done += 1
                 self.latencies.append(time.perf_counter() - t)
                 if future.exception() is None:
                     self.committed += 1
+                    if cross:
+                        self.cross_committed += 1
                 else:
                     self.rejected += 1
 
@@ -184,20 +240,27 @@ class _Firehose:
             p99_ms=pct(0.99),
             width=self.flow.width,
             sigs_signed=self.sigs_signed,
+            cross_requested=self.cross_requested,
+            cross_committed=self.cross_committed,
         )
 
 
 @register_flow(name="loadgen.FirehoseFlow")
 class FirehoseFlow(FlowLogic):
     """RPC-startable firehose: start_flow("loadgen.FirehoseFlow", n_tx,
-    width, inflight, rate_tx_s) → FirehoseResult."""
+    width, inflight, rate_tx_s, cross_frac) → FirehoseResult.
+
+    cross_frac > 0 makes every round(1/cross_frac)-th move consume inputs
+    owned by two different notary shards (the 2PC path); single-shard moves
+    route to their owning group via the netmap shard directory."""
 
     def __init__(self, n_tx: int, width: int = 1, inflight: int = 64,
-                 rate_tx_s: float = 0.0):
+                 rate_tx_s: float = 0.0, cross_frac: float = 0.0):
         self.n_tx = n_tx
         self.width = width
         self.inflight = inflight
         self.rate_tx_s = rate_tx_s
+        self.cross_frac = cross_frac
 
     def call(self):
         result = yield self.service_request(lambda: _Firehose(self).poll)

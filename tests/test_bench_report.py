@@ -23,6 +23,7 @@ def _stub_phases(monkeypatch):
                  "bench_raft_open_loop",  # unstubbed, this one ran a REAL
                  # multiprocess raft sweep (and now a sidecar) inside every
                  # report test — minutes of suite time measuring nothing
+                 "bench_shard_scaling",  # ditto: boots up to 4 raft groups
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -54,6 +55,11 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     assert report["vs_baseline"] == round(1200.0 / 50_000.0, 3)
     assert report["baseline_configs"]["raft_notary_3node"] == {
         "stub": "bench_raft_cluster"}
+    # The shard-scaling section must ride the DEVICE phase path too (the
+    # host-only path asserts it separately) — schema parity is the
+    # contract trend tooling greps against.
+    assert report["baseline_configs"]["shard_scaling"] == {
+        "stub": "bench_shard_scaling"}
     assert "phase" not in report
 
 
@@ -107,6 +113,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_multisig"}
     assert report["baseline_configs"]["resolve_ids"] == {
         "stub": "bench_resolve_ids"}
+    assert report["baseline_configs"]["shard_scaling"] == {
+        "stub": "bench_shard_scaling"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
 
 
@@ -272,6 +280,55 @@ def test_raft_open_loop_report_carries_sidecar_and_occupancy(monkeypatch):
     host = bench.bench_raft_open_loop(rates=(30.0,), n_tx=200)
     assert "sidecar" in host and host["sidecar"] is None
     assert "device_occupancy" in host
+
+
+def test_shard_scaling_report_contract(monkeypatch):
+    """The shard_scaling section's one-line-JSON contract: one entry per
+    shard count carrying throughput + the per-group ledger audit, plus the
+    cross_shard_mix adversarial section whose exactly_once verdict and
+    ledger-row arithmetic (expected = committed + cross_committed) must
+    always be present — trend tooling greps these keys flat."""
+    from corda_tpu.tools import loadtest
+
+    calls = []
+
+    def fake_mp(**kw):
+        calls.append(kw)
+        shards = kw["shards"]
+        committed = kw["n_tx"]
+        cross = committed // 2 if kw.get("cross_frac") else 0
+        r = _fake_multiprocess_result()
+        r.shards = shards
+        r.tx_committed = committed
+        r.tx_per_sec = 50.0 * shards  # monotone: the acceptance trend
+        r.cross_requested = cross
+        r.cross_committed = cross
+        r.per_group_committed = [committed // shards] * shards
+        r.ledger_committed = committed + cross
+        r.ledger_expected = committed + cross
+        r.reserved_leaked = 0
+        r.exactly_once = True
+        return r
+
+    monkeypatch.setattr(loadtest, "run_loadtest_multiprocess", fake_mp)
+    out = bench.bench_shard_scaling(shard_counts=(1, 2, 4), n_tx=8)
+
+    assert set(out["shards"]) == {"1", "2", "4"}
+    trend = [out["shards"][k]["tx_per_sec"] for k in ("1", "2", "4")]
+    assert trend == sorted(trend)  # the acceptance bar the bench states
+    for section in out["shards"].values():
+        assert section["exactly_once"] is True
+        assert "per_group_committed" in section
+        assert "p99_ms" in section
+    mix = out["cross_shard_mix"]
+    assert mix["shards"] == 2 and mix["cross_frac"] == 0.5
+    assert mix["ledger_committed"] == mix["ledger_expected"]
+    assert mix["reserved_leaked"] == 0
+    assert mix["exactly_once"] is True
+    # The adversarial run actually asked for the 2PC mix.
+    assert calls[-1]["cross_frac"] == 0.5 and calls[-1]["shards"] == 2
+    # And every run used real OS-process groups of 1 member.
+    assert all(kw["cluster_size"] == 1 for kw in calls)
 
 
 def test_verifier_stamp_reports_device_occupancy():
