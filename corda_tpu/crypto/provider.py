@@ -249,6 +249,22 @@ class DeviceRoutedVerifier(BatchVerifier):
     def _verify_ed25519_device(self, jobs: Sequence[VerifyJob]) -> np.ndarray:
         raise NotImplementedError
 
+    def pack_device(self, jobs: Sequence[VerifyJob]):
+        """Split seam for pipelined callers (the sidecar's double-buffered
+        executor): host-side columnar packing of a batch, separable from the
+        device dispatch, so batch N+1 packs while batch N runs on the
+        device. Returns an opaque handle for :meth:`verify_packed`, or None
+        when this batch would NOT take the device tier (size/gate routing
+        says host, mixed schemes, nothing well-formed) — the caller then
+        falls back to the ordinary verify_batch path, which routes
+        identically. Base verifiers don't support the split."""
+        return None
+
+    def verify_packed(self, packed) -> np.ndarray:
+        """Dispatch a handle produced by :meth:`pack_device`. Counts as a
+        device batch (routing was already decided at pack time)."""
+        raise NotImplementedError
+
     def warm(self) -> None:
         """Compile THIS verifier's device path at both pump bucket sizes,
         bypassing the gate/size routing. Blocking and exception-raising —
@@ -334,6 +350,31 @@ class MeshVerifier(DeviceRoutedVerifier):
         return sharded.verify_batch_sharded(
             [j.pubkey for j in jobs], [j.message for j in jobs],
             [j.sig for j in jobs], self.mesh)
+
+    def pack_device(self, jobs: Sequence[VerifyJob]):
+        """Host half of the mesh dispatch, routed EXACTLY like
+        _verify_ed25519: batches the size/gate crossover would host-route
+        return None (so the pipelined caller's fallback lands on the same
+        tier this verifier would have chosen), as do mixed-scheme batches
+        (the split path only accelerates the pure-ed25519 firehose shape)
+        and all-malformed batches (the host tier answers those for free)."""
+        if (not jobs
+                or len(jobs) < self.device_min_sigs
+                or (self.device_gate is not None
+                    and not self.device_gate.is_set())
+                or any(j.scheme != "ed25519" for j in jobs)):
+            return None
+        from ..ops import sharded
+
+        return sharded.pack_batch_sharded(
+            [j.pubkey for j in jobs], [j.message for j in jobs],
+            [j.sig for j in jobs], self.mesh)
+
+    def verify_packed(self, packed) -> np.ndarray:
+        from ..ops import sharded
+
+        self.device_batches += 1
+        return sharded.dispatch_packed(packed)
 
     def warm(self) -> None:
         """Compile the SHARDED graphs this verifier actually dispatches

@@ -22,15 +22,33 @@ Server structure (mirrors async_verify.py's pipeline, one level up):
                      flushing early when pending sigs reach max_sigs
                      (bucket capacity). Whole requests only — a request is
                      never split across batches, so per-client replies stay
-                     one frame.
-  executor thread  — concatenates the coalesced requests into one
-                     verify_batch call on the server's verifier (the
+                     one frame. After forming a batch it also runs the
+                     HOST half of the device dispatch (pack_device:
+                     columnar packing into padded kernel arrays), so
+                     packing batch N+1 overlaps device execution of
+                     batch N on the executor thread.
+  executor thread  — dispatches the pre-packed arrays (verify_packed) or,
+                     for host-routed/unpackable batches, one verify_batch
+                     call on the server's verifier (the
                      DeviceRoutedVerifier size/gate routing and the padded
                      pick_bucket executable cache in ops/ed25519_jax are
                      reused unchanged), then splits results per request.
   depth-2 buffering: a BoundedSemaphore(depth) between scheduler and
-                     executor lets the scheduler coalesce the NEXT batch
-                     while the current one runs on the device.
+                     executor lets the scheduler coalesce AND pack the
+                     NEXT batch while the current one runs on the device.
+
+Mesh ownership (round 10): ``devices=N`` makes the server own a JAX device
+mesh instead of one chip — the verifier becomes a MeshVerifier whose
+coalesced buckets are sharded data-parallel across the N local devices
+(ops/sharded.py shard_map with fixed in/out shardings, so repeated
+dispatches reuse one executable per bucket and never re-partition). The
+bucket ladder is rounded up to a multiple of the mesh size
+(pad_to_devices), every device gets an equal slice, and the pad waste is
+attributed in stats (pad_fraction / per_device_occupancy /
+per_device_batch_sigs_hist). devices=1 keeps the exact single-device
+verifier; a mesh that cannot be built (fewer local devices than asked)
+leaves the boot-warm gate closed so every batch takes the oracle-exact
+host tier — degraded throughput, never a wrong answer.
 
 Wire protocol — length-prefixed frames over a stream socket (unix path or
 host:port), little-endian throughout:
@@ -97,6 +115,24 @@ def bucket_for(n: int) -> int:
         if n <= b:
             return b
     return BUCKETS[-1]
+
+
+def pad_to_devices(n: int, n_devices: int) -> int:
+    """Smallest multiple of n_devices >= max(n, n_devices) — mirrored from
+    ops/sharded.py (pure arithmetic) for the same reason BUCKETS mirrors
+    pick_bucket: pad attribution must work without importing jax."""
+    return -(-max(n, 1) // max(n_devices, 1)) * max(n_devices, 1)
+
+
+# Adaptive coalesce_us policy (ROADMAP item 1: grow the deadline from the
+# observed batch-size histogram so the mesh sees full buckets; shrink it
+# when batches fill early so p99 never pays for an idle window). Same
+# hysteresis/multiplicative-step idiom as async_verify.AdaptiveCrossover.
+ADAPT_WINDOW = 8        # executed batches per decision
+ADAPT_GROW = 1.5
+ADAPT_SHRINK = 0.75
+ADAPT_SEED_US = 200     # first growth step out of coalesce_us=0
+ADAPT_CEILING_US = 20_000
 
 
 # ---------------------------------------------------------------------------
@@ -226,16 +262,35 @@ class SidecarServer:
 
     def __init__(self, address: str, verifier=None, verifier_kind: str = "cpu",
                  coalesce_us: int = 2000, max_sigs: int = 4096,
-                 depth: int = 2, device_min_sigs: int | None = None):
+                 depth: int = 2, device_min_sigs: int | None = None,
+                 devices: int | None = None,
+                 adaptive_coalesce: bool = False):
         self.address = address
-        self.verifier = verifier if verifier is not None else make_verifier(
-            verifier_kind)
+        self.devices = int(devices or 0)
+        if verifier is None:
+            verifier = self._make_server_verifier(verifier_kind, self.devices)
+        self.verifier = verifier
+        if not self.devices:
+            self.devices = int(getattr(verifier, "n_devices", None) or 0)
         if device_min_sigs is not None and hasattr(
                 self.verifier, "device_min_sigs"):
             self.verifier.device_min_sigs = device_min_sigs
         self.coalesce_us = int(coalesce_us)
+        self.coalesce_us_initial = int(coalesce_us)
+        self.adaptive_coalesce = bool(adaptive_coalesce)
+        self.coalesce_adjustments = 0
+        self._win_batches = 0
+        self._win_requests = 0
+        self._win_sigs = 0
         self.max_sigs = int(max_sigs)
         self.depth = int(depth)
+        # Mesh bookkeeping: mesh_devices is the PROVEN mesh size (set by the
+        # warm thread once make_mesh succeeds); warm_error records why a
+        # device/mesh tier never opened. Pad attribution prefers the packed
+        # handle's exact numbers and falls back to arithmetic on these.
+        self.mesh_devices: int | None = None
+        self.warm_error: str | None = None
+
         self._pending: deque[_Pending] = deque()
         self._cv = threading.Condition()
         self._exec_q: queue.SimpleQueue = queue.SimpleQueue()
@@ -256,6 +311,32 @@ class SidecarServer:
         self.batch_sigs_hist: dict[int, int] = {}
         self.wait_s_total = 0.0
         self.verify_s_total = 0.0
+        # Mesh/pipeline accounting: packed_batches took the split
+        # pack-then-dispatch path (packing overlapped the previous batch's
+        # device execution); device_lanes counts lanes actually DISPATCHED
+        # on the device tier (bucket-padded), pad_lanes the subset carrying
+        # no real signature; the per-device histogram keys by each device's
+        # lane share per dispatch.
+        self.packed_batches = 0
+        self.pack_s_total = 0.0
+        self.device_lanes = 0
+        self.pad_lanes = 0
+        self.per_device_batch_sigs_hist: dict[int, int] = {}
+
+    @staticmethod
+    def _make_server_verifier(kind: str, devices: int):
+        """devices > 1 upgrades any jax-tier verifier to a mesh-owning
+        MeshVerifier over exactly that many local devices; devices <= 1
+        keeps the PR-5 single-device tiers bit-identical (``jax`` stays
+        JaxVerifier). A cpu verifier ignores devices — there is no device
+        tier to shard."""
+        if devices > 1 and kind.startswith("jax"):
+            from .provider import MeshVerifier
+
+            return MeshVerifier(
+                n_devices=devices,
+                shadow_rate=0.05 if kind == "jax-shadow" else 0.0)
+        return make_verifier(kind)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -295,18 +376,36 @@ class SidecarServer:
             return
         gate = threading.Event()
         verifier.device_gate = gate
+        # Class-level lookup on purpose: `mesh` is a LAZY property that
+        # builds the mesh (and raises when the host can't) — probing the
+        # instance would pull that raise into start() instead of the warm
+        # thread, where it belongs.
+        is_mesh = hasattr(type(verifier), "mesh")
 
         def _warm() -> None:
+            ok = False
             try:
+                if is_mesh:
+                    # The mesh must be PROVEN before the gate opens:
+                    # make_mesh raises when fewer local devices exist than
+                    # asked for, and an open gate would route every batch
+                    # into that raise.
+                    self.mesh_devices = int(verifier.mesh.devices.size)
                 import jax
 
-                if jax.default_backend() == "cpu":
-                    gate.set()  # CPU-backend compiles are cheap; no warm
-                    return
-                verifier.warm()
-            except Exception:
-                pass  # gate stays closed; degrade/re-probe policy applies
-            finally:
+                if jax.default_backend() != "cpu":
+                    verifier.warm()
+                # else: CPU-backend compiles are cheap; no warm needed
+                ok = True
+            except Exception as exc:
+                self.warm_error = f"{type(exc).__name__}: {exc}"
+            if ok or not is_mesh:
+                # Non-mesh verifiers keep the PR-5 contract: the gate opens
+                # even after a failed warm, the first failing dispatch
+                # produces an error REPLY, and the client degrades. A mesh
+                # that could not be built must never open the gate — every
+                # batch host-routes to the oracle-exact tier instead of
+                # raising per batch (degraded throughput, right answers).
                 gate.set()
 
         threading.Thread(target=_warm, daemon=True,
@@ -426,21 +525,45 @@ class SidecarServer:
             if self._stop.is_set():
                 self._slots.release()
                 return
-            self._exec_q.put(batch)
+            # Host half of the device dispatch runs HERE, on the scheduler
+            # thread: while the executor holds the device with batch N,
+            # this packs batch N+1's kernel arrays (limb decompression,
+            # radix split, bucket padding) — the depth-2 slot already
+            # admitted it. pack_device routes exactly like verify_batch
+            # would (size/gate/scheme), returning None for batches the
+            # verifier would host-route; the executor then takes the
+            # ordinary unsplit path, so routing semantics never fork.
+            jobs = [j for p in batch for j in p.jobs]
+            packed = None
+            pack_s = 0.0
+            pack_fn = getattr(self.verifier, "pack_device", None)
+            if pack_fn is not None:
+                t_pack = time.perf_counter()
+                try:
+                    packed = pack_fn(jobs)
+                except Exception:
+                    packed = None  # unsplit path decides (and may reply ERR)
+                pack_s = time.perf_counter() - t_pack
+            self._exec_q.put((batch, jobs, packed, pack_s))
 
     # -- executor -----------------------------------------------------------
 
     def _executor(self) -> None:
         while True:
-            batch = self._exec_q.get()
-            if batch is _STOP:
+            item = self._exec_q.get()
+            if item is _STOP:
                 return
-            jobs = [j for p in batch for j in p.jobs]
+            batch, jobs, packed, pack_s = item
             before_dev = getattr(self.verifier, "device_batches", 0) or 0
             t0 = time.perf_counter()
             err = None
             try:
-                ok = self.verifier.verify_batch(jobs)
+                if packed is not None:
+                    # Pre-packed by the scheduler (overlapped with the
+                    # previous batch's device execution): dispatch only.
+                    ok = self.verifier.verify_packed(packed)
+                else:
+                    ok = self.verifier.verify_batch(jobs)
             except Exception as exc:  # noqa: BLE001
                 # Providers reject-never-raise, but a dying device backend
                 # can still throw; an error REPLY (not silence) lets the
@@ -460,6 +583,26 @@ class SidecarServer:
                 self.batch_sigs_hist[b] = self.batch_sigs_hist.get(b, 0) + 1
                 self.verify_s_total += verify_s
                 self.wait_s_total += sum(t0 - p.received_at for p in batch)
+                if packed is not None:
+                    self.packed_batches += 1
+                    self.pack_s_total += pack_s
+                if tier == 1 and err is None:
+                    # Pad attribution: the packed handle knows the exact
+                    # dispatched bucket and mesh width; the unsplit device
+                    # path is reconstructed arithmetically (same ladder).
+                    ndev = (packed.n_devices if packed is not None
+                            else (self.mesh_devices or self.devices or 1))
+                    lanes = (packed.bucket if packed is not None
+                             else pad_to_devices(bucket_for(len(jobs)), ndev))
+                    real = (len(packed.good) if packed is not None
+                            else len(jobs))
+                    self.device_lanes += lanes
+                    self.pad_lanes += lanes - real
+                    share = lanes // ndev
+                    self.per_device_batch_sigs_hist[share] = (
+                        self.per_device_batch_sigs_hist.get(share, 0) + 1)
+                if self.adaptive_coalesce:
+                    self._adapt_observe(len(batch), len(jobs))
             offset = 0
             for p in batch:
                 n = len(p.jobs)
@@ -479,6 +622,41 @@ class SidecarServer:
                     pass  # client died mid-batch: its flows replay
             self._slots.release()
 
+    # -- adaptive coalescing ------------------------------------------------
+
+    def _adapt_observe(self, n_requests: int, n_sigs: int) -> None:
+        """Retune coalesce_us from the observed batch fill — called under
+        self._lock per executed batch when adaptive_coalesce is on. Every
+        ADAPT_WINDOW batches: if batches fill to >= max_sigs/2 the deadline
+        is pure added latency, shrink it multiplicatively; if they run
+        below max_sigs/4 WHILE multiple requests are coalescing per batch
+        (more company would actually arrive), grow it toward the ceiling so
+        the mesh sees fuller buckets. The band between the thresholds is
+        hysteresis — no change. Only the WINDOW LENGTH ever changes: the
+        scheduler still anchors the deadline on the oldest pending request
+        and still flushes early at max_sigs, so the p99 contract (no
+        request waits more than coalesce_us for company) holds at the new
+        value from the next batch on."""
+        self._win_batches += 1
+        self._win_requests += n_requests
+        self._win_sigs += n_sigs
+        if self._win_batches < ADAPT_WINDOW:
+            return
+        mean = self._win_sigs / self._win_batches
+        coalescing = self._win_requests > self._win_batches
+        self._win_batches = self._win_requests = self._win_sigs = 0
+        cur = self.coalesce_us
+        if mean >= self.max_sigs / 2:
+            new = int(cur * ADAPT_SHRINK)
+        elif mean < self.max_sigs / 4 and coalescing:
+            new = min(ADAPT_CEILING_US,
+                      max(ADAPT_SEED_US, int(cur * ADAPT_GROW)))
+        else:
+            return
+        if new != cur:
+            self.coalesce_us = new
+            self.coalesce_adjustments += 1
+
     # -- stats --------------------------------------------------------------
 
     def stats(self) -> dict:
@@ -486,9 +664,18 @@ class SidecarServer:
 
         v = self.verifier
         gate = getattr(v, "device_gate", None)
+        dev_b = getattr(v, "device_batches", None)
+        host_b = getattr(v, "host_batches", None)
+        occupancy = None
+        if dev_b is not None and host_b is not None:
+            total = dev_b + host_b
+            occupancy = round(dev_b / total, 3) if total else 0.0
         with self._lock:
             hist = {str(k): self.batch_sigs_hist[k]
                     for k in sorted(self.batch_sigs_hist)}
+            per_dev_hist = {str(k): self.per_device_batch_sigs_hist[k]
+                            for k in sorted(self.per_device_batch_sigs_hist)}
+            lanes, pad = self.device_lanes, self.pad_lanes
             return {
                 "address": self.address,
                 "verifier": getattr(v, "name", None),
@@ -499,12 +686,33 @@ class SidecarServer:
                 "cross_request_batches": self.cross_request_batches,
                 "errors": self.errors,
                 "batch_sigs_hist": hist,
-                "device_batches": getattr(v, "device_batches", None),
-                "host_batches": getattr(v, "host_batches", None),
+                "device_batches": dev_b,
+                "host_batches": host_b,
                 "device_min_sigs": getattr(v, "device_min_sigs", None),
                 "device_ready": (gate.is_set() if gate is not None
                                  else None),
+                "device_occupancy": occupancy,
+                # Mesh ownership: configured width, the PROVEN mesh size
+                # (None until the warm thread builds it), why the warm/mesh
+                # failed, and the pad/occupancy attribution per dispatched
+                # device lane. per_device_occupancy is the fraction of each
+                # device's lane share carrying a real signature (identical
+                # across devices — the batch axis shards equally).
+                "devices": self.devices or None,
+                "mesh_devices": self.mesh_devices,
+                "warm_error": self.warm_error,
+                "packed_batches": self.packed_batches,
+                "pack_s_total": round(self.pack_s_total, 6),
+                "device_lanes": lanes,
+                "pad_lanes": pad,
+                "pad_fraction": (round(pad / lanes, 4) if lanes else 0.0),
+                "per_device_occupancy": (
+                    round((lanes - pad) / lanes, 4) if lanes else 0.0),
+                "per_device_batch_sigs_hist": per_dev_hist,
                 "coalesce_us": self.coalesce_us,
+                "coalesce_us_initial": self.coalesce_us_initial,
+                "adaptive_coalesce": self.adaptive_coalesce,
+                "coalesce_adjustments": self.coalesce_adjustments,
                 "max_sigs": self.max_sigs,
                 "depth": self.depth,
                 "wait_s_total": round(self.wait_s_total, 6),
@@ -531,6 +739,14 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="batches formed-or-in-flight (double buffer)")
     parser.add_argument("--device-min-sigs", type=int, default=None,
                         help="override the server verifier's size crossover")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="own a JAX device mesh of this many local "
+                             "devices (data-parallel sharded verify); 1 or "
+                             "unset keeps the single-device tier")
+    parser.add_argument("--adaptive-coalesce", action="store_true",
+                        help="retune coalesce_us from the observed batch "
+                             "fill (grow toward full buckets, shrink when "
+                             "batches fill early)")
     args = parser.parse_args(argv)
 
     if args.verifier.startswith("jax"):
@@ -540,7 +756,8 @@ def main(argv: Sequence[str] | None = None) -> int:
     server = SidecarServer(
         args.socket, verifier_kind=args.verifier,
         coalesce_us=args.coalesce_us, max_sigs=args.max_sigs,
-        depth=args.depth, device_min_sigs=args.device_min_sigs)
+        depth=args.depth, device_min_sigs=args.device_min_sigs,
+        devices=args.devices, adaptive_coalesce=args.adaptive_coalesce)
     server.start()
     # The driver's wait_up parses this banner, like the node's.
     print(f"sidecar up at {server.address}", flush=True)

@@ -57,6 +57,11 @@ class BatchConfig:
     # Client-side round-trip deadline for one sidecar batch; a miss
     # degrades the node to its local host tier (cooldown re-probe re-opens).
     sidecar_deadline_ms: float = 2000.0
+    # Mesh width of the host's sidecar (informational on the client side:
+    # stamped into node_metrics so harnesses can attribute which mesh
+    # served a run; the server's --devices flag is authoritative). 0 =
+    # unknown/single-device.
+    sidecar_devices: int = 0
 
 
 @dataclass(frozen=True)
@@ -192,6 +197,7 @@ class NodeConfig:
                 sidecar=str(batch.get("sidecar", "")),
                 sidecar_deadline_ms=float(
                     batch.get("sidecar_deadline_ms", 2000.0)),
+                sidecar_devices=int(batch.get("sidecar_devices", 0)),
             ),
             raft=RaftConfig(
                 group_commit=bool(raft.get("group_commit", True)),

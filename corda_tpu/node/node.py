@@ -168,7 +168,8 @@ class Node:
 
             verifier = SidecarVerifier(
                 sidecar_addr,
-                deadline_ms=config.batch.sidecar_deadline_ms)
+                deadline_ms=config.batch.sidecar_deadline_ms,
+                devices=config.batch.sidecar_devices or None)
         else:
             verifier = _make_verifier(config.verifier)
 

@@ -61,7 +61,8 @@ class SidecarVerifier(DeviceRoutedVerifier):
     def __init__(self, address: str, deadline_ms: float = 2000.0,
                  device_min_sigs: int | None = None,
                  connect_timeout_s: float = 1.0,
-                 reprobe_cooldown_s: float | None = None):
+                 reprobe_cooldown_s: float | None = None,
+                 devices: int | None = None):
         if device_min_sigs is None:
             device_min_sigs = int(os.environ.get(
                 "CORDA_TPU_SIDECAR_MIN_SIGS", SIDECAR_MIN_SIGS_DEFAULT))
@@ -70,6 +71,12 @@ class SidecarVerifier(DeviceRoutedVerifier):
         self.deadline_s = float(deadline_ms) / 1e3
         self.connect_timeout_s = connect_timeout_s
         self.reprobe_cooldown_s = reprobe_cooldown_s
+        # Mesh width the config SAYS the server owns ([batch]
+        # sidecar_devices): stamped for attribution; the server snapshot
+        # below carries the proven value.
+        self.devices = devices or None
+        self._server_snapshot: dict | None = None
+        self._server_snapshot_t = 0.0
         self._sock: socket.socket | None = None
         self._req_id = 0
         # Serialises the socket: the feeder thread and the degrade
@@ -222,7 +229,27 @@ class SidecarVerifier(DeviceRoutedVerifier):
             "degraded": self.degraded,
             "reprobes_ok": self.reprobes_ok,
             "reprobes_failed": self.reprobes_failed,
+            "devices": self.devices,
+            "server": self._server_stats_maybe(),
         }
+
+    def _server_stats_maybe(self) -> dict | None:
+        """Best-effort server-side snapshot (per-device occupancy, pad
+        fraction, mesh size) riding the client stamp into node_metrics —
+        fetched over a FRESH connection so it never contends with an
+        in-flight verify, cached 5 s so metrics polls stay cheap, and None
+        (never an exception) when the server is unreachable."""
+        now = time.monotonic()
+        if (self._server_snapshot is not None
+                and now - self._server_snapshot_t < 5.0):
+            return self._server_snapshot
+        try:
+            snap = fetch_sidecar_stats(self.address, timeout=0.5)
+        except SidecarError:
+            snap = None
+        self._server_snapshot = snap
+        self._server_snapshot_t = now
+        return snap
 
 
 def fetch_sidecar_stats(address: str, timeout: float = 2.0) -> dict:

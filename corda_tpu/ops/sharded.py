@@ -34,7 +34,8 @@ else:  # jax < 0.6: experimental path, and the kwarg was named check_rep
     _NO_CHECK = {"check_rep": False}
 
 __all__ = ["make_mesh", "sharded_verify_fn", "sharded_verify_hashed_fn",
-           "verify_batch_sharded", "pad_to_devices"]
+           "verify_batch_sharded", "pad_to_devices",
+           "pack_batch_sharded", "dispatch_packed", "PackedShardedBatch"]
 
 BATCH_AXIS = "sigs"
 
@@ -76,6 +77,14 @@ def _sharded_fn(graph_fn, mesh: Mesh):
     key = (graph_fn, mesh)
     fn = _FN_CACHE.get(key)
     if fn is None:
+        # Route the sharded compiles through the host_cpu_signature()-keyed
+        # persistent cache (MULTICHIP_r05 tail: "Compile machine features
+        # ... doesn't match" — an XLA:CPU AOT artifact compiled on one
+        # machine type was loaded on another; the keyed dir partitions the
+        # cache per CPU feature set so stale artifacts are never loaded).
+        from . import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
         inner = _shard_map(
             graph_fn, mesh=mesh, in_specs=_IN_SPECS, out_specs=_OUT_SPEC,
             **_NO_CHECK,
@@ -113,19 +122,47 @@ def sharded_verify_hashed_fn(mesh: Mesh):
     return _sharded_fn(_verify_hashed_graph, mesh)
 
 
-def verify_batch_sharded(pubkeys, msgs, sigs, mesh: Mesh) -> np.ndarray:
-    """End-to-end sharded verify: bool[len(sigs)], malformed inputs reject.
+class PackedShardedBatch:
+    """Host-packed kernel arrays awaiting a mesh dispatch.
 
-    Host packing and path dispatch are shared with the single-chip tier:
-    all-32-byte messages (tx ids) hash on device; the bucket is rounded up to
-    a multiple of the mesh size so every device gets an equal slice.
-    """
+    The pack half (CPU: decompress limbs, radix-split words, pad to the
+    bucket) and the dispatch half (device: the sharded verify executable)
+    are split so a pipelined caller — the sidecar's depth-2 executor — can
+    pack batch N+1 on the host while batch N runs on the mesh."""
+
+    __slots__ = ("n", "good", "arrays", "fn", "bucket", "n_devices")
+
+    def __init__(self, n, good, arrays, fn, bucket, n_devices):
+        self.n = n                  # total lanes requested (incl. malformed)
+        self.good = good            # indices packed into the arrays
+        self.arrays = arrays        # four (8, bucket) uint32 word arrays
+        self.fn = fn                # jit(shard_map) executable, mesh-bound
+        self.bucket = bucket        # padded lane count actually dispatched
+        self.n_devices = n_devices
+
+    @property
+    def pad_lanes(self) -> int:
+        """Lanes dispatched that carry no real signature (bucket ladder
+        round-up + pad_to_devices) — the waste the stats attribute."""
+        return self.bucket - len(self.good)
+
+
+def pack_batch_sharded(pubkeys, msgs, sigs,
+                       mesh: Mesh) -> "PackedShardedBatch | None":
+    """Host half of the sharded verify: filter malformed lanes, pick the
+    bucket (rounded to a multiple of the mesh size so every device gets an
+    equal slice), and columnar-pack the kernel arrays. Returns None when no
+    lane is well-formed (the caller answers all-False without a dispatch).
+
+    The returned executable is the cached jit(shard_map) for this mesh —
+    in/out shardings are fixed by _IN_SPECS/_OUT_SPEC, so repeated
+    dispatches at the same bucket reuse one executable and never
+    re-partition."""
     n = len(sigs)
-    ok = np.zeros(n, bool)
     good = [i for i in range(n)
             if len(bytes(pubkeys[i])) == 32 and len(bytes(sigs[i])) == 64]
     if not good:
-        return ok
+        return None
     ndev = mesh.devices.size
     bucket = pad_to_devices(ed25519_jax.pick_bucket(len(good)), ndev)
     gp = [pubkeys[i] for i in good]
@@ -134,10 +171,32 @@ def verify_batch_sharded(pubkeys, msgs, sigs, mesh: Mesh) -> np.ndarray:
     if ed25519_jax.device_hash_eligible(gm):
         arrays, _ = ed25519_jax.precompute_batch_device(gp, gm, gs,
                                                         bucket=bucket)
-        out = np.asarray(sharded_verify_hashed_fn(mesh)(*arrays))
+        fn = sharded_verify_hashed_fn(mesh)
     else:
         arrays, _ = ed25519_jax.precompute_batch(gp, gm, gs, bucket=bucket)
-        out = np.asarray(sharded_verify_fn(mesh)(*arrays))
-    for j, i in enumerate(good):
+        fn = sharded_verify_fn(mesh)
+    return PackedShardedBatch(n, good, arrays, fn, bucket, ndev)
+
+
+def dispatch_packed(packed: PackedShardedBatch) -> np.ndarray:
+    """Device half: run the mesh executable and scatter lane results back
+    to the caller's index space (padded lanes verify False and are never
+    visible — bool[packed.n] covers exactly the requested lanes)."""
+    ok = np.zeros(packed.n, bool)
+    out = np.asarray(packed.fn(*packed.arrays))
+    for j, i in enumerate(packed.good):
         ok[i] = out[j]
     return ok
+
+
+def verify_batch_sharded(pubkeys, msgs, sigs, mesh: Mesh) -> np.ndarray:
+    """End-to-end sharded verify: bool[len(sigs)], malformed inputs reject.
+
+    Host packing and path dispatch are shared with the single-chip tier:
+    all-32-byte messages (tx ids) hash on device; the bucket is rounded up to
+    a multiple of the mesh size so every device gets an equal slice.
+    """
+    packed = pack_batch_sharded(pubkeys, msgs, sigs, mesh)
+    if packed is None:
+        return np.zeros(len(sigs), bool)
+    return dispatch_packed(packed)

@@ -456,13 +456,20 @@ class Driver:
                       address: str | None = None,
                       env_extra: dict | None = None,
                       wait: bool = True,
+                      devices: int | None = None,
+                      adaptive_coalesce: bool = False,
                       host: Host | None = None) -> SidecarProcess:
         """Spawn ONE verification sidecar for the host (crypto/sidecar.py).
         Point node processes at it via `[batch] sidecar = "<address>"` (or
         CORDA_TPU_SIDECAR in env_extra) so their verify batches coalesce
         across processes. Default address: a unix socket under the
         sidecar's base dir (falls back to a short /tmp dir when the path
-        would blow the ~108-byte AF_UNIX limit)."""
+        would blow the ~108-byte AF_UNIX limit).
+
+        devices=N makes the sidecar own an N-device mesh (data-parallel
+        sharded verify); on device="cpu" the child gets a VIRTUAL mesh via
+        --xla_force_host_platform_device_count so the mesh code path runs
+        on hosts without accelerators (tests, the host-only bench)."""
         host = host or self.host
         side_dir = self.base_dir / name
         host.mkdir(side_dir)
@@ -474,12 +481,22 @@ class Driver:
                 address = str(Path(tempfile.mkdtemp(
                     prefix="corda-tpu-sc-")) / "sc.sock")
         env = _node_env(device)
+        if devices and devices > 1 and device != "accelerator":
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{devices}").strip()
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
         argv = self._SIDECAR_ARGV + [
             "--socket", address, "--verifier", verifier,
             "--coalesce-us", str(coalesce_us),
             "--max-sigs", str(max_sigs), "--depth", str(depth)]
+        if devices:
+            argv += ["--devices", str(devices)]
+        if adaptive_coalesce:
+            argv += ["--adaptive-coalesce"]
         process = host.spawn(argv, side_dir / "sidecar.log",
                              self._NODE_CWD, env)
         handle = SidecarProcess(name, side_dir, address, process, host=host)

@@ -650,8 +650,15 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             "adaptive_adjustments": av.get("adaptive_adjustments"),
             # Sidecar CLIENT stamps (node/verify_client.py): batches/sigs
             # shipped to the shared server, fallbacks, gate state; None
-            # when this member runs without a sidecar.
+            # when this member runs without a sidecar. The client stamp
+            # embeds a cached SERVER snapshot ("server") whose mesh fields
+            # are hoisted flat here so artifacts grep them per member.
             "sidecar": metrics.get("sidecar"),
+            "sidecar_devices": ((metrics.get("sidecar") or {}).get("server")
+                                or {}).get("mesh_devices"),
+            "sidecar_per_device_occupancy": (
+                ((metrics.get("sidecar") or {}).get("server")
+                 or {}).get("per_device_occupancy")),
             "async_verify": av or None,
             "pipeline_depth": av.get("depth"),
             "overlap_ratio": overlap,
@@ -696,6 +703,9 @@ def run_loadtest_multiprocess(
     # every raft member feeds it, so micro-batches coalesce ACROSS
     # processes (crypto/sidecar.py) instead of host-routing per process
     sidecar_coalesce_us: int = 2000,
+    sidecar_devices: int = 0,  # > 1: the sidecar owns an N-device mesh and
+    # shards each coalesced bucket data-parallel across it (ops/sharded.py;
+    # a virtual CPU mesh when notary_device == "cpu")
     shards: int = 0,  # > 0: boot `shards` independent raft groups of
     # `cluster_size` members each, partitioned by StateRef hash
     # (node/services/sharding.py); requires a raft-flavoured `notary`
@@ -720,6 +730,8 @@ def run_loadtest_multiprocess(
                f"async_depth = {async_depth}\n")
         if sidecar_addr:
             out += f"sidecar = {json.dumps(sidecar_addr)}\n"
+            if sidecar_devices:
+                out += f"sidecar_devices = {int(sidecar_devices)}\n"
         return out
 
     disruptions: list[str] = []
@@ -736,7 +748,7 @@ def run_loadtest_multiprocess(
             side = d.start_sidecar(
                 verifier=verifier, device=notary_device,
                 coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
-                env_extra=trace_env)
+                devices=sidecar_devices or None, env_extra=trace_env)
         side_addr = side.address if side is not None else ""
         toml_extra = _extra(verifier, side_addr)
         # Followers stay on the host crypto path even when the leader runs
@@ -1071,6 +1083,7 @@ def run_latency_sweep(
     sidecar: bool = False,  # one host-wide verification sidecar; members
     # feed it so batches coalesce across processes (crypto/sidecar.py)
     sidecar_coalesce_us: int = 2000,
+    sidecar_devices: int = 0,  # > 1: the sidecar owns an N-device mesh
 ) -> SweepResult:
     """Open-loop tail-latency measurement: a notary (or raft cluster) +
     `clients` client processes, the firehose driven at each offered load in
@@ -1097,6 +1110,8 @@ def run_latency_sweep(
                f"async_depth = {async_depth}\n")
         if sidecar_addr:
             out += f"sidecar = {json.dumps(sidecar_addr)}\n"
+            if sidecar_devices:
+                out += f"sidecar_devices = {int(sidecar_devices)}\n"
         return out
 
     results: dict = {}
@@ -1110,7 +1125,7 @@ def run_latency_sweep(
             side = d.start_sidecar(
                 verifier=verifier, device=notary_device,
                 coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
-                env_extra=trace_env)
+                devices=sidecar_devices or None, env_extra=trace_env)
         side_addr = side.address if side is not None else ""
         toml_extra = _extra(verifier, side_addr)
         members = _start_notary_processes(
@@ -1267,6 +1282,12 @@ def main(argv=None) -> int:
                          "If the sidecar dies, members degrade to their "
                          "local host tier and re-probe on a cooldown — "
                          "at-least-once replay, never a wrong answer")
+    ap.add_argument("--sidecar-devices", type=int, default=0,
+                    help="mesh width the sidecar owns (--sidecar only): the "
+                         "driver passes --devices to the sidecar process "
+                         "and, on cpu hosts, forces a virtual device mesh "
+                         "of that size so the data-parallel verify plane "
+                         "is exercised end to end")
     ap.add_argument("--shards", type=int, default=0,
                     help="boot N independent raft notary groups partitioned "
                          "by StateRef hash (--processes + raft notary); "
@@ -1281,6 +1302,9 @@ def main(argv=None) -> int:
     if args.sidecar and not args.processes:
         ap.error("--sidecar requires --processes (one sidecar per HOST "
                  "only makes sense with real OS-process nodes)")
+    if args.sidecar_devices and not args.sidecar:
+        ap.error("--sidecar-devices requires --sidecar (the mesh lives "
+                 "inside the sidecar server)")
     if args.chaos is not None or args.kill_leader:
         result = run_chaos_loadtest(
             plan=args.chaos, n_tx=args.tx, cluster_size=args.cluster_size,
@@ -1297,6 +1321,7 @@ def main(argv=None) -> int:
             max_wait_ms=args.max_wait_ms, disrupt=args.disrupt,
             notary_device=args.notary_device,
             trace=args.trace, sidecar=args.sidecar,
+            sidecar_devices=args.sidecar_devices,
             shards=args.shards, cross_frac=args.cross_frac)
     else:
         result = run_loadtest(

@@ -24,6 +24,7 @@ def _stub_phases(monkeypatch):
                  # multiprocess raft sweep (and now a sidecar) inside every
                  # report test — minutes of suite time measuring nothing
                  "bench_shard_scaling",  # ditto: boots up to 4 raft groups
+                 "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -60,6 +61,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # contract trend tooling greps against.
     assert report["baseline_configs"]["shard_scaling"] == {
         "stub": "bench_shard_scaling"}
+    # Multi-chip verify-plane scaling rides the device phase path (real
+    # mesh) AND the host-only path (virtual mesh) — same schema both ways.
+    assert report["baseline_configs"]["multichip_scaling"] == {
+        "stub": "bench_multichip_scaling"}
     assert "phase" not in report
 
 
@@ -115,6 +120,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_resolve_ids"}
     assert report["baseline_configs"]["shard_scaling"] == {
         "stub": "bench_shard_scaling"}
+    assert report["baseline_configs"]["multichip_scaling"] == {
+        "stub": "bench_multichip_scaling"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
 
 
@@ -329,6 +336,69 @@ def test_shard_scaling_report_contract(monkeypatch):
     assert calls[-1]["cross_frac"] == 0.5 and calls[-1]["shards"] == 2
     # And every run used real OS-process groups of 1 member.
     assert all(kw["cluster_size"] == 1 for kw in calls)
+
+
+def test_multichip_scaling_report_contract(monkeypatch):
+    """The multichip_scaling section's one-line-JSON contract: one entry
+    per mesh width carrying parity-checked sigs/s + pad/occupancy
+    attribution, the flat sigs_per_sec_by_devices trend (monotone
+    non-decreasing on a mesh-capable harness — the acceptance bar),
+    scaling_1_to_max, and per-config error isolation. Mirrors the
+    shard_scaling contract so trend tooling greps both the same way."""
+    calls = []
+
+    def fake_round(devices, **kw):
+        calls.append((devices, kw))
+        return {"devices": devices, "n_sigs": kw.get("n_sigs", 4096),
+                "rounds": kw.get("rounds", 5),
+                "sigs_per_sec": 10_000.0 * devices,  # near-linear
+                "p50_ms": 8.0 / devices, "p99_ms": 12.0 / devices,
+                "parity_ok": True, "client_fallbacks": 0,
+                "mesh_devices": devices, "warm_error": None,
+                "pad_fraction": 0.01,
+                "per_device_occupancy": 0.99,
+                "per_device_batch_sigs_hist": {str(4096 // devices): 5}}
+
+    monkeypatch.setattr(bench, "_mesh_sidecar_round", fake_round)
+    monkeypatch.setattr(bench, "bench_raft_cluster",
+                        lambda **kw: {"stub": "flagship", **kw})
+
+    out = bench.bench_multichip_scaling(device_counts=(1, 2, 4, 8),
+                                        notary_device="accelerator",
+                                        flagship=True)
+    assert out["mesh"] == "device"
+    assert set(out["devices"]) == {"1", "2", "4", "8"}
+    trend = [out["sigs_per_sec_by_devices"][k] for k in ("1", "2", "4", "8")]
+    assert trend == sorted(trend)  # monotone: the acceptance bar
+    assert out["scaling_1_to_max"] == 8.0  # >= 6x at 8 vs 1 passes
+    for section in out["devices"].values():
+        assert section["parity_ok"] is True
+        assert section["warm_error"] is None
+        assert "per_device_occupancy" in section
+        assert "pad_fraction" in section
+    # The flagship ran the production topology fed by the widest mesh.
+    flag = out["flagship_mesh_sidecar"]
+    assert flag["sidecar"] is True and flag["sidecar_devices"] == 8
+    assert flag["notary_device"] == "accelerator"
+    # Every round targeted the requested harness.
+    assert [d for d, _ in calls] == [1, 2, 4, 8]
+    assert all(kw["notary_device"] == "accelerator" for _, kw in calls)
+
+    # Host-only shape: virtual mesh, no flagship, one failing width must
+    # not take down the section (per-config error isolation).
+    def flaky_round(devices, **kw):
+        if devices == 4:
+            raise RuntimeError("mesh boot failed")
+        return fake_round(devices, **kw)
+
+    monkeypatch.setattr(bench, "_mesh_sidecar_round", flaky_round)
+    host = bench.bench_multichip_scaling(device_counts=(1, 2, 4),
+                                         n_sigs=1024, rounds=3)
+    assert host["mesh"] == "virtual-cpu"
+    assert "flagship_mesh_sidecar" not in host
+    assert host["devices"]["4"] == {"error": "RuntimeError: mesh boot failed"}
+    assert set(host["sigs_per_sec_by_devices"]) == {"1", "2"}
+    assert "scaling_1_to_max" not in host  # max width errored: no ratio
 
 
 def test_verifier_stamp_reports_device_occupancy():
