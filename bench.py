@@ -1207,6 +1207,18 @@ def main():
         "unit": "sigs/sec",
         "vs_baseline": 0.0,
     }
+    # Invariant-analyzer stamp: live finding count over the shipped tree
+    # (0 == every machine-checked contract holds for the code this run
+    # measured). Advisory in the report — a broken analyzer must never
+    # cost a bench line, so any failure stamps -1 instead of raising.
+    try:
+        from corda_tpu.analysis import analyze_paths
+
+        report["analysis_findings"] = len(analyze_paths(
+            [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "corda_tpu")]).findings)
+    except Exception:  # noqa: BLE001 - the one-line contract wins
+        report["analysis_findings"] = -1
     cancel_watchdog = _install_watchdog(
         int(os.environ.get("CORDA_TPU_BENCH_TIMEOUT", "2700")), report) \
         or (lambda: None)  # tests stub the installer out

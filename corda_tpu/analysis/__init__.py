@@ -1,0 +1,41 @@
+"""Static invariant analyzer for the corda_tpu tree.
+
+``python -m corda_tpu.analysis corda_tpu/`` runs every rule over the tree
+and exits 0 iff no live (unsuppressed, unbaselined) findings remain. The
+rules machine-check the framework's load-bearing prose invariants —
+determinism of the replicated apply paths, no silent exception swallowing
+on verify/notarise paths, one cached jit executable per (graph, mesh), no
+blocking I/O under general-purpose locks, an acyclic lock-acquisition
+graph, and span names drawn from the obs stage registry.
+
+Stdlib-only (``ast`` + ``json`` + ``re``): importable and runnable with no
+jax present, so tier-1 and bare CI shells can gate on it.
+"""
+
+from __future__ import annotations
+
+from .engine import (
+    DEFAULT_BASELINE,
+    FileContext,
+    Finding,
+    Report,
+    Rule,
+    analyze_paths,
+    analyze_source,
+    baseline_entries_from_findings,
+    load_baseline,
+)
+from .rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "DEFAULT_BASELINE",
+    "FileContext",
+    "Finding",
+    "Report",
+    "Rule",
+    "analyze_paths",
+    "analyze_source",
+    "baseline_entries_from_findings",
+    "load_baseline",
+]

@@ -341,6 +341,7 @@ class MeshVerifier(DeviceRoutedVerifier):
         if self._mesh is None:
             from ..ops import sharded
 
+            # lint: allow(no-jit-in-hotpath) lazy one-time constructor: the mesh is built once and memoised on self._mesh; per-batch calls only read the cached object
             self._mesh = sharded.make_mesh(self.n_devices)
         return self._mesh
 

@@ -238,6 +238,7 @@ class _Client:
         self.lock = threading.Lock()
 
     def reply(self, payload: bytes) -> None:
+        # lint: allow(no-blocking-under-lock) this per-client lock EXISTS to serialize frames on one socket (executor vs reader thread); nothing else ever contends on it
         with self.lock:
             send_frame(self.conn, payload)
 
@@ -464,9 +465,14 @@ class SidecarServer:
                 if op == OP_VERIFY:
                     _, jobs = decode_verify_request(payload)
                     pend = _Pending(client, req_id, jobs)
+                    # Stats counters mutate under _lock (the lock stats()
+                    # reads them under) — never under _cv, so the two locks
+                    # are never held together and reader threads can't
+                    # lose increments against other stats writers.
+                    with self._lock:
+                        self.requests += 1
                     with self._cv:
                         self._pending.append(pend)
-                        self.requests += 1
                         self._cv.notify_all()
                 elif op == OP_STATS:
                     body = json.dumps(self.stats()).encode()

@@ -83,6 +83,7 @@ class _Outbox:
 
     def append(self, peer: str, unique_id: bytes, frame: bytes) -> None:
         if self._db is not None:
+            # lint: allow(no-blocking-under-lock) the outbox lock's purpose IS serializing writes on the shared sqlite connection (node thread vs bridge replay); contenders are sqlite writers, not latency-sensitive readers
             with self._lock:
                 self.stats["appends"] += 1
                 self._db.conn.execute(
@@ -105,6 +106,7 @@ class _Outbox:
         if not entries:
             return
         if self._db is not None:
+            # lint: allow(no-blocking-under-lock) same sqlite write-serialization lock as append(): one burst transaction under the outbox's designated I/O lock
             with self._lock:
                 self._record_burst(len(entries))
                 self._db.conn.executemany(
@@ -261,6 +263,7 @@ class _Dedupe:
                 return True
         if self._db is None:
             return False
+        # lint: allow(no-blocking-under-lock) dedupe lock serializes the sqlite read against concurrent record() writes on the same connection — it is this table's designated I/O lock
         with self._lock:
             row = self._db.conn.execute(
                 "SELECT 1 FROM dedupe WHERE message_id = ?",
@@ -270,6 +273,7 @@ class _Dedupe:
             return row is not None
 
     def record(self, unique_id: bytes) -> None:
+        # lint: allow(no-blocking-under-lock) the mem-mirror insert and the sqlite insert must be atomic vs seen(); this lock is the dedupe table's designated I/O serialization lock
         with self._lock:
             self._mem.add(unique_id)
             if self._db is not None:
@@ -371,14 +375,18 @@ class TcpMessaging(MessagingService):
         # flush_round() AFTER the round commit.
         self._deferred_acks: list[tuple[Any, bytes]] = []
         self._deferred_bridge_peers: set[str] = set()
-        # Bridge writev accounting (see transport_stats).
+        # Bridge writev accounting (see transport_stats). Bumped from every
+        # bridge thread, read from the node/bench thread: the += below are
+        # read-modify-write races without a guard, so all access goes
+        # through _stats_lock (never held across I/O — counter writes only).
+        self._stats_lock = threading.Lock()
         self._flush_stats = {"flushes": 0, "frames": 0, "max_frames": 0}
         # Redelivery accounting (see transport_stats): frames the dedupe
         # layer absorbed (sender resent something we already processed),
-        # and poison messages dropped at the retry cap.
+        # and poison messages dropped at the retry cap. Node-thread-only.
         self._redeliveries = 0
         self._poison_drops = 0
-        self._stale_resends = 0
+        self._stale_resends = 0  # bridge threads; guarded by _stats_lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -509,14 +517,30 @@ class TcpMessaging(MessagingService):
         stuffing the durable outbox of an unreachable peer."""
         return self._outbox.count(str(to))
 
+    def _note_flush(self, n_frames: int) -> None:
+        """Bridge-thread writev accounting. Multiple bridges flush
+        concurrently; dict += is a read-modify-write race, so the bump
+        happens under the dedicated stats lock (counter-only critical
+        section — the sendall stays outside any lock)."""
+        with self._stats_lock:
+            st = self._flush_stats
+            st["flushes"] += 1
+            st["frames"] += n_frames
+            st["max_frames"] = max(st["max_frames"], n_frames)
+
+    def _note_stale_resend(self) -> None:
+        with self._stats_lock:
+            self._stale_resends += 1
+
     def transport_stats(self) -> dict:
         """Self-describing burst stamps: outbox append amortization (bursts
         via append_many vs singleton appends) and the bridge's writev-style
-        multi-frame flushes. Counters are bumped under the outbox lock or on
-        bridge threads without one — approximate under concurrency, which is
-        fine for a throughput attribution stamp."""
+        multi-frame flushes. Outbox counters are bumped under the outbox
+        lock; bridge counters under _stats_lock — exact, not approximate."""
         ob = self._outbox.stats
-        fl = self._flush_stats
+        with self._stats_lock:
+            fl = dict(self._flush_stats)
+            stale = self._stale_resends
         return {
             "outbox_appends": ob["appends"],
             "outbox_bursts": ob["bursts"],
@@ -532,7 +556,7 @@ class TcpMessaging(MessagingService):
             # Redelivery / retry-cap surfacing: how hard the at-least-once
             # machinery is working (and whether the poison cap is biting).
             "redeliveries": self._redeliveries,
-            "stale_resends": self._stale_resends,
+            "stale_resends": stale,
             "poison_pending": len(self._poison),
             "poison_drops": self._poison_drops,
             "poison_retry_limit": self.POISON_RETRIES,
@@ -626,7 +650,7 @@ class TcpMessaging(MessagingService):
             if sent and now - last_stale_check > 1.0:
                 last_stale_check = now
                 if now - min(sent.values()) > self.STALE_RESEND_S:
-                    self._stale_resends += 1
+                    self._note_stale_resend()
                     raise OSError("frames un-ACKed past stale-resend window")
             batch = self._outbox.pending_after(peer, last_seq)
             if not batch and not sent:
@@ -666,10 +690,7 @@ class TcpMessaging(MessagingService):
                 last_seq = max(last_seq, seq)
             if buf:
                 sock.sendall(buf)
-                st = self._flush_stats
-                st["flushes"] += 1
-                st["frames"] += n_frames
-                st["max_frames"] = max(st["max_frames"], n_frames)
+                self._note_flush(n_frames)
             try:
                 frame = _recv_frame(sock)
                 if frame is None:

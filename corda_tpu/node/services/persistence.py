@@ -174,6 +174,7 @@ class NodeDatabase:
 
         @contextlib.contextmanager
         def _batch():
+            # lint: allow(no-blocking-under-lock) db.lock IS the single-writer I/O serialization lock — holding it across the round's commit/rollback is the design (one fsync per round)
             with self.lock:
                 if self._batch_depth == 0:
                     self._batch_thread = threading.get_ident()

@@ -1367,6 +1367,7 @@ class RaftUniquenessProvider(UniquenessProvider):
                     or now - state["submitted_at"] >= self.RESUBMIT_EVERY):
                 self.member.submit(PutAllCommand(
                     refs, tx_id, caller_identity, request_id,
+                    # lint: allow(no-wallclock-in-apply) coordinator stamping site: resubmission re-stamps on the submitting node; replicas only ever see the carried value
                     issued_at=_time.time()))
                 state["submitted_at"] = now
             return None

@@ -261,8 +261,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
             t0 = _obs.now()
 
         def make_command(op):
-            return PutAllCommand(refs, tx_id, caller, op["rid"],
-                                 issued_at=_time.time())
+            return PutAllCommand(
+                refs, tx_id, caller, op["rid"],
+                # lint: allow(no-wallclock-in-apply) coordinator stamping site: clock read once, carried in the command, applied identically by every replica
+                issued_at=_time.time())
 
         def poll():
             now = _time.monotonic()
@@ -298,9 +300,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 _obs.register_link(op["rid"], ctx[0], ctx[1])
 
         def reserve_command(op):
-            return ReserveCommand(by_group[op["group"]], tx_id, caller,
-                                  op["rid"], issued_at=_time.time(),
-                                  ttl_s=self.ttl_s)
+            return ReserveCommand(
+                by_group[op["group"]], tx_id, caller, op["rid"],
+                # lint: allow(no-wallclock-in-apply) coordinator stamping site: the TTL baseline rides the command; replicas compare stamps, never their own clocks
+                issued_at=_time.time(), ttl_s=self.ttl_s)
 
         def commit_command(op):
             return CommitReservedCommand(by_group[op["group"]], tx_id,

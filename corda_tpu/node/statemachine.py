@@ -799,7 +799,11 @@ class StateMachineManager:
                         "service_round_max": 0,
                         # Device-verifier failures absorbed by the host
                         # tier (degrade_device) instead of rejecting flows.
-                        "verify_device_degraded": 0}
+                        "verify_device_degraded": 0,
+                        # Session handler deregistrations that raced flow
+                        # teardown (handler already gone): counted, never
+                        # silently swallowed.
+                        "handler_remove_failures": 0}
         # Per-flow-name timing aggregates (the JMX/Jolokia capability the
         # reference exports per-MBean, reference: Node.kt:313 — here over
         # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
@@ -1372,8 +1376,11 @@ class StateMachineManager:
             if registration is not None:
                 try:
                     self.messaging.remove_message_handler(registration)
-                except Exception:
-                    pass
+                except (LookupError, ValueError):
+                    # Teardown race: the handler was already removed (node
+                    # stop or duplicate finish). Count it — a nonzero rate
+                    # here means deregistration logic regressed.
+                    self.metrics["handler_remove_failures"] += 1
             if session.state == "open" and session.peer_id is not None:
                 try:
                     self._send_session_message(

@@ -31,19 +31,10 @@ from __future__ import annotations
 
 import json
 
-# Batch-level stages: attributed to every trace in attrs["member_traces"].
-# sidecar_wait/sidecar_verify split device_verify for sidecar-routed
-# batches: server-side coalesce wait vs verify wall (crypto/sidecar.py).
-BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
-                "sidecar_verify", "raft_append", "fsync", "replication")
-# Per-trace measured stage spans. shard_reserve/shard_commit are the two
-# phases of the cross-shard 2PC coordinator (services/sharding.py),
-# recorded on the coordinating notary against the client's trace.
-DIRECT_STAGES = ("verify_wait", "shard_reserve", "shard_commit")
-# Full breakdown order (reply is derived).
-STAGES = ("queue_wait", "verify_wait", "device_verify", "sidecar_wait",
-          "sidecar_verify", "shard_reserve", "shard_commit",
-          "raft_append", "fsync", "replication", "reply")
+# Attribution tables come from the span-name registry (obs/stages.py) so
+# the breakdown can never drift from the names recording sites are allowed
+# to use (the trace-stage-registry analyzer rule enforces the other side).
+from .stages import BATCH_STAGES, DIRECT_STAGES, MARKER_SPANS, STAGES
 
 
 def _spans_of(snapshot) -> list[dict]:
@@ -171,7 +162,7 @@ def stage_breakdown(snapshots) -> dict:
             root = entry["root"]
             if root is None or t0 < float(root.get("t_start") or 0.0):
                 entry["root"] = span
-        elif name in ("raft_commit", "notary_process"):
+        elif name in MARKER_SPANS:
             # Stitch markers, not breakdown stages — but their ends bound
             # the derived reply tail.
             entry = slot(trace_id)

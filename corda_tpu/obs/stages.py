@@ -1,0 +1,67 @@
+"""The span-name registry: every stage name the tracing subsystem records.
+
+``collect.stage_breakdown`` attributes latency by matching span names
+against fixed tuples; a span recorded under a name missing from those
+tuples is silently invisible in the breakdown — the failure mode is not an
+error but a stage that never shows up in the bench report. This module is
+the single source of truth both sides key on:
+
+  * ``collect.py`` builds its attribution tables from these tuples, so the
+    breakdown can never drift from the registry;
+  * the static invariant analyzer (``corda_tpu.analysis``, rule
+    ``trace-stage-registry``) checks every literal span name passed to
+    ``_obs.record(...)`` anywhere in the tree against ``SPAN_NAMES`` /
+    ``SPAN_NAME_PREFIXES``, so an instrumentation site with a typo'd or
+    unregistered name fails tier-1 instead of silently dropping out of
+    ``stage_breakdown``.
+
+Adding a stage is therefore a two-line change HERE (name + ordering slot),
+after which the analyzer permits the recording site and the breakdown
+reports it.
+
+Stdlib-only like the rest of ``obs`` — the analyzer imports this module
+from a bare CLI process.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "BATCH_STAGES",
+    "DIRECT_STAGES",
+    "DERIVED_STAGES",
+    "STAGES",
+    "MARKER_SPANS",
+    "SPAN_NAME_PREFIXES",
+    "SPAN_NAMES",
+]
+
+# Batch-level stages: recorded once per batch, attributed to every trace in
+# attrs["member_traces"]. sidecar_wait/sidecar_verify DECOMPOSE
+# device_verify for sidecar-routed batches (crypto/sidecar.py).
+BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
+                "sidecar_verify", "raft_append", "fsync", "replication")
+
+# Per-trace measured stage spans. shard_reserve/shard_commit are the two
+# phases of the cross-shard 2PC coordinator (node/services/sharding.py).
+DIRECT_STAGES = ("verify_wait", "shard_reserve", "shard_commit")
+
+# Derived by stage_breakdown, never recorded: the reply tail is
+# root_end - max(attributed stage end).
+DERIVED_STAGES = ("reply",)
+
+# Full breakdown order the bench report presents.
+STAGES = ("queue_wait", "verify_wait", "device_verify", "sidecar_wait",
+          "sidecar_verify", "shard_reserve", "shard_commit",
+          "raft_append", "fsync", "replication", "reply")
+
+# Stitch markers: recorded per trace to bound the derived reply tail and
+# anchor cross-node correlation, but not themselves breakdown stages.
+MARKER_SPANS = ("raft_commit", "notary_process")
+
+# Dynamic span families: a recorded name may start with one of these
+# prefixes (the root flow span is f"flow:{FlowClassName}").
+SPAN_NAME_PREFIXES = ("flow:",)
+
+# Every literal name a recording site may pass to SpanRecorder.record().
+SPAN_NAMES = frozenset(BATCH_STAGES) | frozenset(DIRECT_STAGES) \
+    | frozenset(MARKER_SPANS)

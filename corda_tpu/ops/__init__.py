@@ -109,5 +109,6 @@ def enable_persistent_compile_cache() -> None:
             # lowering failure.
             jax.config.update("jax_include_full_tracebacks_in_locations",
                               False)
+    # lint: allow(no-silent-except) best-effort config knobs: an older jax without them must not fail import — the cost is slower compiles, not wrong answers
     except Exception:
         pass  # older jax without the knobs: just compile in-process

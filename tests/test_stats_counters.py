@@ -1,0 +1,222 @@
+"""Cross-thread stats-counter regressions (invariant-analyzer sweep).
+
+Three counters were bumped with unguarded read-modify-write from threads
+other than their reader:
+
+  * TcpMessaging._flush_stats / _stale_resends — bumped on every bridge
+    thread, read by transport_stats() on the node/bench thread;
+  * SidecarServer.requests — bumped on per-connection reader threads under
+    the WRONG lock (_cv) while stats() reads under _lock.
+
+The hammer tests below drive the fixed bump paths from many threads with a
+tiny GIL switch interval (which reliably loses updates on the old code) and
+assert EXACT totals. The AST guards pin the structural fix so a refactor
+can't quietly move a bump back outside its lock.
+"""
+
+import ast
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from corda_tpu.crypto.sidecar import SidecarServer
+from corda_tpu.node.messaging.tcp import TcpMessaging
+
+REPO = Path(__file__).resolve().parents[1]
+
+THREADS = 8
+PER_THREAD = 2_000
+
+
+@pytest.fixture
+def tiny_switch_interval():
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(old)
+
+
+def _hammer(fn):
+    threads = [threading.Thread(target=fn) for _ in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+class TestTcpBridgeCounters:
+    def test_concurrent_note_flush_loses_no_updates(self, tiny_switch_interval):
+        messaging = TcpMessaging()  # not started: no sockets, just state
+
+        def bump():
+            for _ in range(PER_THREAD):
+                messaging._note_flush(3)
+
+        _hammer(bump)
+        stats = messaging.transport_stats()
+        assert stats["bridge_flushes"] == THREADS * PER_THREAD
+        assert stats["bridge_flush_frames"] == THREADS * PER_THREAD * 3
+        assert stats["bridge_max_flush"] == 3
+
+    def test_concurrent_stale_resends_lose_no_updates(self, tiny_switch_interval):
+        messaging = TcpMessaging()
+
+        def bump():
+            for _ in range(PER_THREAD):
+                messaging._note_stale_resend()
+
+        _hammer(bump)
+        assert messaging.transport_stats()["stale_resends"] == \
+            THREADS * PER_THREAD
+
+    def test_reads_race_writes_without_tearing(self, tiny_switch_interval):
+        messaging = TcpMessaging()
+        stop = threading.Event()
+        seen = []
+
+        def read():
+            while not stop.is_set():
+                st = messaging.transport_stats()
+                # frames is always exactly 3x flushes: a torn read of the
+                # dict mid-update would break the ratio.
+                assert st["bridge_flush_frames"] == 3 * st["bridge_flushes"]
+                seen.append(st["bridge_flushes"])
+
+        reader = threading.Thread(target=read)
+        reader.start()
+        _hammer(lambda: [messaging._note_flush(3)
+                         for _ in range(PER_THREAD)])
+        stop.set()
+        reader.join()
+        assert messaging.transport_stats()["bridge_flushes"] == \
+            THREADS * PER_THREAD
+
+    def test_flush_stats_only_mutate_inside_guarded_helper(self):
+        """AST guard: every _flush_stats/_stale_resends mutation lives in
+        the _note_* helpers (whose bodies hold _stats_lock) — a new bump
+        site outside them reintroduces the race this file regression-tests."""
+        tree = ast.parse(
+            (REPO / "corda_tpu/node/messaging/tcp.py").read_text())
+        offenders = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in ("_note_flush", "_note_stale_resend",
+                             "__init__"):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.AugAssign):
+                    targets = [sub.target]
+                elif isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                else:
+                    continue
+                tgt = " ".join(ast.unparse(t) for t in targets)
+                # Mutating the counters, or aliasing the live dict (the
+                # old `st = self._flush_stats; st[...] += 1` pattern) —
+                # copies like dict(self._flush_stats) stay legal.
+                aliasing = (isinstance(sub, ast.Assign) and
+                            ast.unparse(sub.value) == "self._flush_stats")
+                if "_flush_stats" in tgt or "_stale_resends" in tgt \
+                        or aliasing:
+                    offenders.append(
+                        f"{node.name}:{sub.lineno}: {ast.unparse(sub)}")
+        assert not offenders, offenders
+
+
+class TestSidecarRequestCounter:
+    def _server(self):
+        # verifier stub: the counter paths never dispatch
+        return SidecarServer("127.0.0.1:0", verifier=object())
+
+    def test_concurrent_request_bumps_lose_no_updates(
+            self, tiny_switch_interval):
+        server = self._server()
+
+        def bump():
+            # the fixed _serve_conn pattern: stats counters under _lock
+            for _ in range(PER_THREAD):
+                with server._lock:
+                    server.requests += 1
+
+        _hammer(bump)
+        assert server.requests == THREADS * PER_THREAD
+
+    def test_request_bump_sits_under_stats_lock_not_cv(self):
+        """AST guard: the `requests += 1` in _serve_conn must be inside a
+        `with self._lock` block (the lock stats() reads under), never back
+        under self._cv where stats-lock writers can race it."""
+        tree = ast.parse(
+            (REPO / "corda_tpu/crypto/sidecar.py").read_text())
+        checked = 0
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.With):
+                continue
+            locks = [ast.unparse(item.context_expr) for item in node.items]
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.AugAssign) and \
+                        ast.unparse(sub.target) == "self.requests":
+                    assert locks == ["self._lock"], (
+                        f"requests bump at line {sub.lineno} under {locks}")
+                    checked += 1
+        assert checked == 1
+
+
+class TestStateMachineHandlerRemoveMetric:
+    def _manager(self, remove_exc):
+        from corda_tpu.node.statemachine import StateMachineManager
+
+        class _Messaging:
+            def remove_message_handler(self, registration):
+                raise remove_exc
+
+        class _Checkpoints:
+            def remove_checkpoint(self, run_id):
+                pass
+
+        class _Changes:
+            def append(self, item):
+                pass
+
+        smm = object.__new__(StateMachineManager)
+        smm.flows = {}
+        smm._dirty_checkpoints = {}
+        smm.checkpoint_storage = _Checkpoints()
+        smm.metrics = {"finished": 0, "handler_remove_failures": 0}
+        smm._record_flow_timing = lambda fsm: None
+        smm.recent_results = {}
+        smm.changes = _Changes()
+        smm._sessions_by_local_id = {}
+        smm._session_handlers = {7: object()}
+        smm.messaging = _Messaging()
+        return smm
+
+    def _fsm(self):
+        class _Session:
+            local_id = 7
+            state = "closed"
+            peer_id = None
+            party = None
+
+        class _Fsm:
+            run_id = b"run"
+            future = object()
+            sessions = {7: _Session()}
+
+        return _Fsm()
+
+    def test_teardown_race_is_counted_not_swallowed(self):
+        smm = self._manager(KeyError("already removed"))
+        smm._flow_finished(self._fsm())
+        assert smm.metrics["handler_remove_failures"] == 1
+
+    def test_unexpected_failures_now_propagate(self):
+        # The old `except Exception: pass` swallowed everything; the
+        # narrowed handler lets genuinely unexpected faults surface.
+        smm = self._manager(RuntimeError("broken messaging"))
+        with pytest.raises(RuntimeError):
+            smm._flow_finished(self._fsm())
