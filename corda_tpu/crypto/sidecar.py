@@ -90,9 +90,21 @@ from .provider import VerifyJob, make_verifier
 OP_VERIFY = 1
 OP_STATS = 2
 OP_PING = 3
+# OP_VERIFY with a QoS prefix (lane code + interactive deadline in epoch
+# ns): same columnar body, same OP_VERIFY reply. Sent only when the
+# client's QoS plane is armed AND its micro-batch carried an interactive
+# deadline — a disarmed cluster never emits this op, and a pre-QoS server
+# rejects it loudly (unknown op drops the connection, the client degrades
+# to its host tier) instead of silently mis-scheduling.
+OP_VERIFY_QOS = 4
 
 STATUS_OK = 0
 STATUS_ERR = 1
+
+# Lane codes on the wire (mirrors qos/context.py; this module stays
+# importable without the qos package on pre-QoS peers).
+LANE_CODE_INTERACTIVE = 0
+LANE_CODE_BULK = 1
 
 # One frame bounds one coalesced request: 64 MiB covers max_sigs=65536 jobs
 # of pubkey+sig+len plus ~900-byte messages — far beyond any pump batch.
@@ -101,6 +113,8 @@ MAX_FRAME = 64 * 1024 * 1024
 _FRAME_HDR = struct.Struct("<I")
 _REQ_HDR = struct.Struct("<BI")
 _VERIFY_REQ_HDR = struct.Struct("<BII")
+# op, req_id, n, lane code, deadline_ns (epoch; 0 = no deadline).
+_VERIFY_QOS_REQ_HDR = struct.Struct("<BIIBQ")
 _REPLY_HDR = struct.Struct("<BIB")
 _VERIFY_REPLY_HDR = struct.Struct("<BIBBff")
 
@@ -161,13 +175,11 @@ def recv_frame(sock: socket.socket) -> bytes:
     return recv_exact(sock, ln)
 
 
-def encode_verify_request(req_id: int, jobs: Sequence[VerifyJob]) -> bytes:
-    """Pack well-formed ed25519 jobs (32-byte keys, 64-byte sigs) into one
-    OP_VERIFY payload. Columnar layout so the server decodes with numpy
-    slices, mirroring the native/_cverify packers."""
+def _encode_jobs(jobs: Sequence[VerifyJob]) -> bytes:
+    """Columnar job body shared by both verify ops: the server decodes
+    with numpy slices, mirroring the native/_cverify packers."""
     n = len(jobs)
     return b"".join((
-        _VERIFY_REQ_HDR.pack(OP_VERIFY, req_id, n),
         b"".join(bytes(j.pubkey) for j in jobs),
         b"".join(bytes(j.sig) for j in jobs),
         np.fromiter((len(j.message) for j in jobs), "<u4", n).tobytes(),
@@ -175,11 +187,7 @@ def encode_verify_request(req_id: int, jobs: Sequence[VerifyJob]) -> bytes:
     ))
 
 
-def decode_verify_request(payload: bytes):
-    """-> (req_id, [VerifyJob...]); raises on a malformed frame (the reader
-    drops the connection — a corrupt stream cannot be resynchronised)."""
-    op, req_id, n = _VERIFY_REQ_HDR.unpack_from(payload)
-    off = _VERIFY_REQ_HDR.size
+def _decode_jobs(payload: bytes, off: int, n: int) -> list[VerifyJob]:
     pks = payload[off:off + 32 * n]
     off += 32 * n
     sigs = payload[off:off + 64 * n]
@@ -197,7 +205,40 @@ def decode_verify_request(payload: bytes):
         off += ln
         jobs.append(VerifyJob(pks[32 * i:32 * i + 32], msg,
                               sigs[64 * i:64 * i + 64]))
-    return req_id, jobs
+    return jobs
+
+
+def encode_verify_request(req_id: int, jobs: Sequence[VerifyJob]) -> bytes:
+    """Pack well-formed ed25519 jobs (32-byte keys, 64-byte sigs) into one
+    OP_VERIFY payload."""
+    return _VERIFY_REQ_HDR.pack(OP_VERIFY, req_id, len(jobs)) \
+        + _encode_jobs(jobs)
+
+
+def decode_verify_request(payload: bytes):
+    """-> (req_id, [VerifyJob...]); raises on a malformed frame (the reader
+    drops the connection — a corrupt stream cannot be resynchronised)."""
+    _op, req_id, n = _VERIFY_REQ_HDR.unpack_from(payload)
+    return req_id, _decode_jobs(payload, _VERIFY_REQ_HDR.size, n)
+
+
+def encode_verify_request_qos(req_id: int, jobs: Sequence[VerifyJob],
+                              lane: int, deadline_ns: int) -> bytes:
+    """OP_VERIFY_QOS: the OP_VERIFY body prefixed with the micro-batch's
+    lane and earliest interactive deadline (epoch ns; 0 = none)."""
+    return _VERIFY_QOS_REQ_HDR.pack(
+        OP_VERIFY_QOS, req_id, len(jobs), lane,
+        deadline_ns & 0xFFFFFFFFFFFFFFFF) + _encode_jobs(jobs)
+
+
+def decode_verify_request_qos(payload: bytes):
+    """-> (req_id, [VerifyJob...], lane, deadline_ns); raises on junk."""
+    _op, req_id, n, lane, deadline_ns = \
+        _VERIFY_QOS_REQ_HDR.unpack_from(payload)
+    if lane not in (LANE_CODE_INTERACTIVE, LANE_CODE_BULK):
+        raise ValueError(f"unknown sidecar lane code {lane}")
+    return (req_id, _decode_jobs(payload, _VERIFY_QOS_REQ_HDR.size, n),
+            lane, deadline_ns)
 
 
 def parse_address(address: str):
@@ -244,14 +285,20 @@ class _Client:
 
 
 class _Pending:
-    __slots__ = ("client", "req_id", "jobs", "received_at")
+    __slots__ = ("client", "req_id", "jobs", "received_at", "lane",
+                 "deadline_ns")
 
     def __init__(self, client: _Client, req_id: int,
-                 jobs: list[VerifyJob]):
+                 jobs: list[VerifyJob], lane: int | None = None,
+                 deadline_ns: int = 0):
         self.client = client
         self.req_id = req_id
         self.jobs = jobs
         self.received_at = time.perf_counter()
+        # QoS prefix from OP_VERIFY_QOS; None/0 for plain OP_VERIFY
+        # requests, which schedule exactly as before.
+        self.lane = lane
+        self.deadline_ns = deadline_ns
 
 
 _STOP = object()
@@ -265,7 +312,8 @@ class SidecarServer:
                  coalesce_us: int = 2000, max_sigs: int = 4096,
                  depth: int = 2, device_min_sigs: int | None = None,
                  devices: int | None = None,
-                 adaptive_coalesce: bool = False):
+                 adaptive_coalesce: bool = False,
+                 qos_guard_us: int = 2000):
         self.address = address
         self.devices = int(devices or 0)
         if verifier is None:
@@ -323,6 +371,13 @@ class SidecarServer:
         self.device_lanes = 0
         self.pad_lanes = 0
         self.per_device_batch_sigs_hist: dict[int, int] = {}
+        # QoS (OP_VERIFY_QOS): flush when the earliest interactive
+        # deadline is this close (converted to ns once), and count how the
+        # deadline scheduler behaved.
+        self.qos_guard_ns = int(qos_guard_us) * 1000
+        self.qos_early_flushes = 0
+        self.qos_interactive_requests = 0
+        self.qos_bulk_requests = 0
 
     @staticmethod
     def _make_server_verifier(kind: str, devices: int):
@@ -462,15 +517,25 @@ class SidecarServer:
             while not self._stop.is_set():
                 payload = recv_frame(client.conn)
                 op, req_id = _REQ_HDR.unpack_from(payload)
-                if op == OP_VERIFY:
-                    _, jobs = decode_verify_request(payload)
-                    pend = _Pending(client, req_id, jobs)
+                if op in (OP_VERIFY, OP_VERIFY_QOS):
+                    if op == OP_VERIFY:
+                        _, jobs = decode_verify_request(payload)
+                        pend = _Pending(client, req_id, jobs)
+                    else:
+                        _, jobs, lane, deadline_ns = \
+                            decode_verify_request_qos(payload)
+                        pend = _Pending(client, req_id, jobs, lane=lane,
+                                        deadline_ns=deadline_ns)
                     # Stats counters mutate under _lock (the lock stats()
                     # reads them under) — never under _cv, so the two locks
                     # are never held together and reader threads can't
                     # lose increments against other stats writers.
                     with self._lock:
                         self.requests += 1
+                        if pend.lane == LANE_CODE_INTERACTIVE:
+                            self.qos_interactive_requests += 1
+                        elif pend.lane == LANE_CODE_BULK:
+                            self.qos_bulk_requests += 1
                     with self._cv:
                         self._pending.append(pend)
                         self._cv.notify_all()
@@ -498,8 +563,48 @@ class SidecarServer:
     def _pending_sigs(self) -> int:
         return sum(len(p.jobs) for p in self._pending)
 
+    def _min_interactive_deadline_ns(self) -> int:
+        """Earliest interactive deadline among pending requests (0 = none).
+        Called under _cv."""
+        dl = 0
+        for p in self._pending:
+            if (p.lane == LANE_CODE_INTERACTIVE and p.deadline_ns > 0
+                    and (dl == 0 or p.deadline_ns < dl)):
+                dl = p.deadline_ns
+        return dl
+
+    def _form_batch(self) -> tuple[list[_Pending], bool]:
+        """Take up to max_sigs from pending. With no bulk requests waiting
+        this is exactly the old FIFO popleft loop (bit-identical order);
+        when both classes wait, interactive (and unlabelled) requests pack
+        first — FIFO within each class — so a full batch is cut from the
+        latency-sensitive end and bulk rides the next one. Returns (batch,
+        any bulk was deferred behind interactive). Called under _cv."""
+        if not any(p.lane == LANE_CODE_BULK for p in self._pending):
+            batch: list[_Pending] = []
+            total = 0
+            while self._pending and total < self.max_sigs:
+                p = self._pending.popleft()
+                batch.append(p)
+                total += len(p.jobs)
+            return batch, False
+        pending = list(self._pending)
+        ordered = ([p for p in pending if p.lane != LANE_CODE_BULK]
+                   + [p for p in pending if p.lane == LANE_CODE_BULK])
+        batch, taken, total = [], set(), 0
+        for p in ordered:
+            if total >= self.max_sigs:
+                break
+            batch.append(p)
+            taken.add(id(p))
+            total += len(p.jobs)
+        self._pending = deque(p for p in pending if id(p) not in taken)
+        reordered = any(p.lane == LANE_CODE_BULK for p in self._pending)
+        return batch, reordered
+
     def _scheduler(self) -> None:
         while True:
+            qos_flush = False
             with self._cv:
                 while not self._pending:
                     if self._stop.is_set():
@@ -511,16 +616,28 @@ class SidecarServer:
                             + self.coalesce_us / 1e6)
                 while (self._pending_sigs() < self.max_sigs
                        and not self._stop.is_set()):
-                    remaining = deadline - time.perf_counter()
+                    limit = deadline
+                    dl_ns = self._min_interactive_deadline_ns()
+                    if dl_ns:
+                        # Translate the epoch-ns interactive deadline onto
+                        # the perf_counter timeline: flush guard_ns before
+                        # it so verify+reply still fit inside the SLO.
+                        qos_limit = (time.perf_counter()
+                                     + (dl_ns - self.qos_guard_ns
+                                        - time.time_ns()) / 1e9)
+                        if qos_limit < limit:
+                            limit = qos_limit
+                    remaining = limit - time.perf_counter()
                     if remaining <= 0:
+                        # Early only on the QoS clock? (coalesce window
+                        # still open = a deadline-triggered flush.)
+                        qos_flush = deadline - time.perf_counter() > 0
                         break
                     self._cv.wait(remaining)
-                batch: list[_Pending] = []
-                total = 0
-                while self._pending and total < self.max_sigs:
-                    p = self._pending.popleft()
-                    batch.append(p)
-                    total += len(p.jobs)
+                batch, _reordered = self._form_batch()
+            if qos_flush:
+                with self._lock:
+                    self.qos_early_flushes += 1
             # Blocks while `depth` batches are in flight — backpressure
             # that keeps the executor at most one batch ahead. Timed so
             # shutdown can't wedge this thread if the executor exited
@@ -723,6 +840,11 @@ class SidecarServer:
                 "depth": self.depth,
                 "wait_s_total": round(self.wait_s_total, 6),
                 "verify_s_total": round(self.verify_s_total, 6),
+                # QoS deadline scheduler (OP_VERIFY_QOS clients).
+                "qos_guard_us": self.qos_guard_ns // 1000,
+                "qos_early_flushes": self.qos_early_flushes,
+                "qos_interactive_requests": self.qos_interactive_requests,
+                "qos_bulk_requests": self.qos_bulk_requests,
             }
 
 
@@ -753,6 +875,10 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="retune coalesce_us from the observed batch "
                              "fill (grow toward full buckets, shrink when "
                              "batches fill early)")
+    parser.add_argument("--qos-guard-us", type=int, default=2000,
+                        help="flush a coalescing batch this long before "
+                             "the earliest interactive deadline "
+                             "(OP_VERIFY_QOS clients)")
     args = parser.parse_args(argv)
 
     if args.verifier.startswith("jax"):
@@ -763,7 +889,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         args.socket, verifier_kind=args.verifier,
         coalesce_us=args.coalesce_us, max_sigs=args.max_sigs,
         depth=args.depth, device_min_sigs=args.device_min_sigs,
-        devices=args.devices, adaptive_coalesce=args.adaptive_coalesce)
+        devices=args.devices, adaptive_coalesce=args.adaptive_coalesce,
+        qos_guard_us=args.qos_guard_us)
     server.start()
     # The driver's wait_up parses this banner, like the node's.
     print(f"sidecar up at {server.address}", flush=True)

@@ -108,6 +108,24 @@ class NotaryUnavailable(NotaryError):
 
 @register
 @dataclass(frozen=True)
+class OverloadedError(NotaryError):
+    """Admission control shed the request at the notarise entry point
+    before any verification or consensus work was done. RETRYABLE like
+    NotaryUnavailable: says nothing about the transaction — only that the
+    service chose to shed THIS lane's load right now. retry_after_ms is
+    the server's backoff suggestion (token-bucket refill estimate, capped);
+    notarise_with_retry uses it as the floor for its next park."""
+
+    lane: str = ""
+    retry_after_ms: float = 0.0
+
+    def __str__(self):
+        return (f"Notary admission control shed {self.lane or 'request'} "
+                f"load (retry after {self.retry_after_ms:.0f} ms)")
+
+
+@register
+@dataclass(frozen=True)
 class NotarySignaturesMissing(NotaryError):
     missing: frozenset
 
@@ -328,13 +346,21 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
         try:
             return (yield from flow.sub_flow(notary_flow))
         except NotaryException as e:
-            if not isinstance(e.error, NotaryUnavailable):
+            # OverloadedError is the admission-control shed: retryable for
+            # the same reason NotaryUnavailable is — nothing was decided
+            # about the transaction, the service just declined the work.
+            if not isinstance(e.error, (NotaryUnavailable, OverloadedError)):
                 raise
             attempt += 1
             now = _time.monotonic()
             if (deadline is None and attempt > retries) or \
                     (deadline is not None and now >= deadline):
                 raise
+            shed = isinstance(e.error, OverloadedError)
+            if shed and e.error.retry_after_ms > 0:
+                # The server's refill estimate floors the park: retrying
+                # sooner would just be shed again at the same bucket.
+                backoff = max(backoff, e.error.retry_after_ms / 1e3)
             hint = getattr(e.error, "leader_hint", None)
             if hint:
                 resolved = _resolve_member(flow, hint)
@@ -349,8 +375,18 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
                 wake_at = now + min(backoff, max_backoff_s)
                 if deadline is not None:
                     wake_at = min(wake_at, deadline)
+                pctx = (_obs.get_context()
+                        if shed and _obs.ACTIVE is not None else None)
+                t_park = _obs.now() if pctx is not None else 0.0
                 yield flow.service_request(
                     lambda wake_at=wake_at: _timer_poll(wake_at))
+                if pctx is not None and _obs.ACTIVE is not None:
+                    # Client-side cost of the shed: the backoff park shows
+                    # up in the stage breakdown as admission_wait.
+                    _obs.record("admission_wait", t_park, _obs.now(),
+                                trace_id=pctx[0], parent=pctx[1],
+                                attrs={"lane": e.error.lane,
+                                       "attempt": attempt})
                 backoff = min(backoff * 2, max_backoff_s)
 
 
@@ -376,6 +412,7 @@ class NotaryServiceFlow(FlowLogic):
         t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
         try:
             request = req.unwrap(self._validate_request)
+            self._admit_or_shed()
             stx = request.tx
             req_identity = request.caller_identity
             wtx = stx.tx
@@ -418,6 +455,28 @@ class NotaryServiceFlow(FlowLogic):
         if not isinstance(request, SignRequest):
             raise ValueError(f"Expected SignRequest, got {type(request).__name__}")
         return request
+
+    def _admit_or_shed(self) -> None:
+        """QoS admission control at the notarise entry point: consult the
+        node's AdmissionController (attached to the service token when
+        [qos] enabled; absent otherwise — zero work on the disabled path)
+        BEFORE any verify/consensus work. A shed raises the retryable
+        OverloadedError, which rides the ordinary NotaryFailure reply."""
+        admission = getattr(self.service, "admission", None)
+        if admission is None:
+            return
+        from ..qos.context import LANE_INTERACTIVE
+
+        sm = self.state_machine
+        qctx = getattr(sm, "qos", None)
+        # Unlabelled traffic admits through the interactive bucket: legacy
+        # clients must never be out-prioritised by labelled bulk load.
+        lane = qctx.lane if qctx is not None else LANE_INTERACTIVE
+        depth = sm.manager.qos_queue_depth()
+        retry_after_s = admission.admit(lane, depth)
+        if retry_after_s is not None:
+            raise NotaryException(
+                OverloadedError(lane, retry_after_s * 1e3))
 
     def _validate_timestamp(self, wtx) -> None:
         if wtx.timestamp is not None and not self.service.timestamp_checker.is_valid(

@@ -83,6 +83,34 @@ class RaftConfig:
 
 
 @dataclass(frozen=True)
+class QosConfig:
+    """QoS plane policy (corda_tpu/qos): priority lanes, deadlines, and
+    admission control. ``enabled = false`` (the default) leaves the plane
+    disarmed — every touch point short-circuits on one attribute check and
+    behaviour is bit-identical to the pre-QoS tree."""
+
+    enabled: bool = False
+    # Default interactive SLO: flows started without an explicit deadline
+    # get admitted_at + slo_ms. The sweep bench judges p99 against this.
+    slo_ms: float = 50.0
+    # How long before an interactive deadline the queueing points stop
+    # coalescing and flush (SMM verify micro-batch, sidecar scheduler,
+    # Raft group-commit round).
+    deadline_guard_ms: float = 5.0
+    # Anti-starvation: with both lanes runnable, every Nth pump pick takes
+    # the oldest bulk step.
+    bulk_every: int = 4
+    # Admission token buckets, per lane (requests/s + burst; rate 0 =
+    # unlimited). Bulk additionally sheds above the queue watermark.
+    interactive_rate: float = 0.0
+    interactive_burst: float = 32.0
+    bulk_rate: float = 0.0
+    bulk_burst: float = 32.0
+    # Runnable-backlog ceiling above which bulk is shed; 0 disables.
+    queue_watermark: int = 0
+
+
+@dataclass(frozen=True)
 class ShardConfig:
     """Sharded-notary topology (services/sharding.py).
 
@@ -117,6 +145,7 @@ class NodeConfig:
     verifier: str = "cpu"  # cpu | jax | jax-shadow | jax-sharded
     batch: BatchConfig = field(default_factory=BatchConfig)
     raft: RaftConfig = field(default_factory=RaftConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
     # Sharded notary: when set (count > 1 or groups non-empty), this raft-*
     # notary member is one shard of a partitioned uniqueness service and
     # uses the ShardedUniquenessProvider two-phase coordinator.
@@ -142,7 +171,7 @@ class NodeConfig:
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
                  "network_map", "map_service", "map_node", "tls", "web_port",
-                 "verifier", "batch", "raft", "rpc_users", "cordapps",
+                 "verifier", "batch", "raft", "qos", "rpc_users", "cordapps",
                  "notary_shards"}
         unknown = set(raw) - known
         if unknown:
@@ -158,6 +187,7 @@ class NodeConfig:
         nm = raw.get("network_map")
         batch = raw.get("batch", {})
         raft = raw.get("raft", {})
+        qos = raw.get("qos", {})
         shards_raw = raw.get("notary_shards")
         shards = None
         if shards_raw is not None:
@@ -203,6 +233,17 @@ class NodeConfig:
                 group_commit=bool(raft.get("group_commit", True)),
                 pipeline_window=int(raft.get("pipeline_window", 1024)),
                 append_chunk=int(raft.get("append_chunk", 256)),
+            ),
+            qos=QosConfig(
+                enabled=bool(qos.get("enabled", False)),
+                slo_ms=float(qos.get("slo_ms", 50.0)),
+                deadline_guard_ms=float(qos.get("deadline_guard_ms", 5.0)),
+                bulk_every=int(qos.get("bulk_every", 4)),
+                interactive_rate=float(qos.get("interactive_rate", 0.0)),
+                interactive_burst=float(qos.get("interactive_burst", 32.0)),
+                bulk_rate=float(qos.get("bulk_rate", 0.0)),
+                bulk_burst=float(qos.get("bulk_burst", 32.0)),
+                queue_watermark=int(qos.get("queue_watermark", 0)),
             ),
             notary_shards=shards,
             rpc_users=tuple(

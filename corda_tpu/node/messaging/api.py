@@ -52,6 +52,10 @@ class Message:
     # Transports stamp it on send only when obs.ACTIVE is armed — the
     # disabled path never grows the envelope.
     trace: Any = None
+    # QoS context (qos/context.py): the sending flow's QosContext, or None
+    # when the QoS plane is disarmed / the sender carried none. Same
+    # arming discipline as trace: disarmed, the envelope never grows.
+    qos: Any = None
 
 
 class MessageHandlerRegistration:

@@ -29,6 +29,7 @@ from .api import (
     fresh_message_id,
 )
 from ...obs import trace as _obs
+from ...qos import context as _qos
 from ...testing import faults as _faults
 
 
@@ -209,12 +210,16 @@ class InMemoryMessaging(MessagingService):
         trace = None
         if _obs.ACTIVE is not None:
             trace = _obs.get_context()
+        qos = None
+        if _qos.ACTIVE is not None:
+            qos = _qos.get_context()
         message = Message(
             topic_session=topic_session,
             data=data,
             unique_id=fresh_message_id(),
             sender=self._address,
             trace=trace,
+            qos=qos,
         )
         self._sends += 1
         self._network._transmit(self._address, to, message)

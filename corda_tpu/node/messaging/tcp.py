@@ -38,6 +38,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from ...obs import trace as _obs
+from ...qos import context as _qos
 from ...serialization.codec import DeserializationError, deserialize, register, serialize
 from ...testing import faults as _faults
 from .api import (
@@ -439,9 +440,11 @@ class TcpMessaging(MessagingService):
                     data: bytes) -> tuple:
         """The "msg" wire tuple. 7 fields normally; when tracing is armed
         AND the sending thread carries a context, two fields (trace_id,
-        span_id) ride at the end — readers accept both widths, so mixed
-        armed/disarmed clusters interoperate and the disabled path never
-        grows a frame."""
+        span_id) ride at the end; when the QoS plane is armed AND the
+        thread carries a QosContext, ONE packed-bytes field follows.
+        Widths therefore land on 7/8/9/10, each unambiguous — readers
+        accept all four, so mixed armed/disarmed clusters interoperate and
+        the disabled path never grows a frame."""
         base = (
             "msg", topic_session.topic, topic_session.session_id, unique_id,
             self.my_address.host, self.my_address.port, data,
@@ -449,7 +452,11 @@ class TcpMessaging(MessagingService):
         if _obs.ACTIVE is not None:
             ctx = _obs.get_context()
             if ctx is not None:
-                return base + (ctx[0], ctx[1])
+                base = base + (ctx[0], ctx[1])
+        if _qos.ACTIVE is not None:
+            qctx = _qos.get_context()
+            if qctx is not None:
+                base = base + (qctx.to_wire(),)
         return base
 
     def send(self, topic_session: TopicSession, data: bytes, to: Any) -> None:
@@ -778,19 +785,27 @@ class TcpMessaging(MessagingService):
                     kind = decoded[0]
                     if kind != "msg":
                         continue
-                    # 7 fields plain; 9 when the sender had tracing armed
-                    # (trailing trace_id/span_id). Both widths are valid.
-                    if len(decoded) == 9:
-                        (_, topic, session_id, unique_id, shost, sport,
-                         data, w_trace, w_span) = decoded
+                    # 7 fields plain; +2 (trace_id/span_id) when the sender
+                    # had tracing armed; +1 packed QosContext when the QoS
+                    # plane was armed. Widths 7/8/9/10 are all valid and
+                    # unambiguous (trace always precedes qos).
+                    width = len(decoded)
+                    trace = None
+                    qos = None
+                    (_, topic, session_id, unique_id, shost, sport,
+                     data) = decoded[:7]
+                    if width in (9, 10):
+                        w_trace, w_span = decoded[7], decoded[8]
                         if not (isinstance(w_trace, bytes)
                                 and isinstance(w_span, bytes)):
                             continue
                         trace = (w_trace, w_span)
-                    else:
-                        _, topic, session_id, unique_id, shost, sport, data = \
-                            decoded
-                        trace = None
+                    if width in (8, 10):
+                        qos = _qos.QosContext.from_wire(decoded[width - 1])
+                        if qos is None:
+                            continue  # malformed QoS field: junk frame
+                    elif width not in (7, 9):
+                        continue
                     # Field TYPES are part of the wire contract: hostile
                     # well-formed frames with wrong-typed fields must die
                     # here, not on the node's pump thread (dedupe hashes
@@ -812,6 +827,7 @@ class TcpMessaging(MessagingService):
                     unique_id=unique_id,
                     sender=TcpAddress(shost, sport),
                     trace=trace,
+                    qos=qos,
                 )
                 self._inbound.put((conn, message))
         except (OSError, DeserializationError):

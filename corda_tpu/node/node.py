@@ -26,6 +26,8 @@ import time
 from collections import deque
 
 from ..flows.data_vending import install_data_vending
+from ..obs import trace as _obs
+from ..qos import context as _qos
 from ..utils.clock import Clock
 from .config import NetMapEntry, NodeConfig, netmap_load, netmap_register
 from .messaging.tcp import TcpMessaging
@@ -67,6 +69,17 @@ class Node:
 
     def __init__(self, config: NodeConfig):
         self.config = config
+        if config.qos.enabled:
+            # Arm the QoS plane BEFORE any subsystem that reads
+            # _qos.ACTIVE at send/schedule time (messaging, SMM, raft).
+            # Process-wide like the obs/faults arming; qos.enabled=False
+            # leaves ACTIVE None and every instrumentation point is a
+            # single attribute check — bit-identical to the pre-QoS tree.
+            from ..qos import context as _qos_ctx
+
+            _qos_ctx.arm(config.name, slo_ms=config.qos.slo_ms,
+                         deadline_guard_ms=config.qos.deadline_guard_ms,
+                         bulk_every=config.qos.bulk_every)
         config.base_dir.mkdir(parents=True, exist_ok=True)
         self.db = NodeDatabase(config.base_dir / "node.db")
         self.key = self.db.load_or_create_identity(config.name)
@@ -245,6 +258,19 @@ class Node:
             self.notary_service = cls(
                 self.smm, self.services, self.identity, self.key,
                 self.uniqueness_provider)
+            if config.qos.enabled:
+                # Admission control at the notarise entry point: the
+                # controller rides the service token NotaryServiceFlow
+                # already carries (read via getattr — absent means no
+                # admission work at all on the disabled path).
+                from ..qos import AdmissionController
+
+                self.notary_service.admission = AdmissionController(
+                    interactive_rate=config.qos.interactive_rate,
+                    interactive_burst=config.qos.interactive_burst,
+                    bulk_rate=config.qos.bulk_rate,
+                    bulk_burst=config.qos.bulk_burst,
+                    queue_watermark=config.qos.queue_watermark)
 
         # -- vault rebuild + scheduler ------------------------------------
         # The vault is an in-memory projection of durable transaction
@@ -518,6 +544,18 @@ class Node:
                 aged = pending and (
                     time.monotonic() - self.smm.verify_waiting_since
                     >= batch.max_wait_ms / 1e3)
+                # Deadline-aware coalescing (QoS queueing point 1): an
+                # interactive request's SLO deadline entering the guard
+                # window flushes the micro-batch NOW instead of waiting
+                # out max_wait_ms. False whenever the plane is disarmed.
+                rushed = pending and self.smm.verify_deadline_pressure()
+                if rushed and not aged and (svc is None
+                                            or svc.can_submit()):
+                    _qos.ACTIVE.counters["verify_early_flushes"] += 1
+                    if _obs.ACTIVE is not None:
+                        mark = _obs.now()
+                        _obs.record("qos_flush", mark, mark,
+                                    attrs={"point": "verify_batch"})
                 if svc is not None:
                     # Pipelined: submit and continue. The gate targets the
                     # device crossover (accumulating ACROSS rounds) once
@@ -525,9 +563,10 @@ class Node:
                     # accumulating — bounded by depth, drained above.
                     if pending and svc.can_submit() and (
                             pending >= svc.target_sigs(batch.max_sigs)
-                            or aged):
+                            or aged or rushed):
                         self.smm.submit_pending_verifies()
-                elif pending and (pending >= batch.max_sigs or aged):
+                elif pending and (pending >= batch.max_sigs or aged
+                                  or rushed):
                     self.smm.flush_pending_verifies()
                 t5 = t()
                 self.smm.flush_checkpoints()
@@ -654,6 +693,9 @@ def main(argv: list[str] | None = None) -> int:
     from ..obs import trace as _obs
 
     _obs.arm_from_env(config.name)
+    # QoS plane: normally armed from [qos] in the config (Node.__init__);
+    # CORDA_TPU_QOS arms it env-wise for ad-hoc runs. A no-op when unset.
+    _qos.arm_from_env(config.name)
     node = Node(config).start()
     print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
     # Attribution hook: CORDA_TPU_NODE_PROFILE=<dir> dumps a cProfile of

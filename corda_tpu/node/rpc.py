@@ -27,6 +27,7 @@ from typing import Any
 from ..crypto.hashes import SecureHash
 from ..flows.api import flow_registry
 from ..obs import trace as _obs
+from ..qos import context as _qos
 from ..serialization.codec import deserialize, register, serialize
 from ..testing import faults as _faults
 from .messaging.api import Message, MessagingService, TopicSession
@@ -246,6 +247,16 @@ class NodeRpcOps:
             # dropped span counts, or None while disarmed.
             "obs": (_obs.ACTIVE.stats()
                     if _obs.ACTIVE is not None else None),
+            # QoS plane stamps (qos/context.py): per-lane flow counts,
+            # anti-starvation picks, early flushes — plus the admission
+            # controller's admitted/shed counters when one is attached to
+            # the notary service. None while disarmed.
+            "qos": (_qos.ACTIVE.stats()
+                    if _qos.ACTIVE is not None else None),
+            "admission": (
+                self._node.notary_service.admission.stats()
+                if getattr(getattr(self._node, "notary_service", None),
+                           "admission", None) is not None else None),
             # Device-tier degrade bookkeeping (crypto/provider.py
             # degrade_device): demotions and re-probe outcomes.
             "verify_device_degrades": getattr(smm.verifier, "degraded", None),

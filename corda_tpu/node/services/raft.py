@@ -45,6 +45,7 @@ from typing import Any, Callable, Sequence
 from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
 from ...obs import trace as _obs
+from ...qos import context as _qos
 from ...serialization.codec import deserialize, register, serialize
 from ...testing import faults as _faults
 from ..messaging.api import MessagingService, TopicSession
@@ -228,6 +229,22 @@ class ClientCommitBatch:
 
     commands: tuple  # (PutAllCommand, ...)
     reply_to: str
+
+
+@register
+@dataclass(frozen=True)
+class ClientCommitBatchQos:
+    """Follower->leader forwarding with QoS context riding along: one
+    wire-encoded QosContext per command (b"" = unlabelled), positionally
+    parallel to `commands`, so the leader's deadline-aware batch seal can
+    see forwarded interactive deadlines too. Sent only when the QoS plane
+    is armed AND at least one buffered command carries a context — a
+    qos-disabled deployment never sees this type on the wire, keeping the
+    classic frame set byte-identical."""
+
+    commands: tuple  # (PutAllCommand, ...)
+    reply_to: str
+    qos: tuple  # (bytes, ...) parallel to commands; b"" = no context
 
 
 @register
@@ -425,6 +442,7 @@ class RaftMember:
             "forward_commands": 0,  # commands inside them
             "replication_rtt_s": 0.0,  # broadcast -> quorum commit, summed
             "replication_rtt_n": 0,
+            "qos_early_seals": 0,   # rounds sealed early for a deadline
         }
         messaging.add_message_handler(RAFT_TOPIC, 0, self._on_message)
 
@@ -631,7 +649,17 @@ class RaftMember:
             return
         self.metrics["forward_frames"] += 1
         self.metrics["forward_commands"] += len(cmds)
-        if len(cmds) == 1:
+        qos_wire = None
+        plane = _qos.ACTIVE
+        if plane is not None:
+            encoded = tuple(
+                ctx.to_wire() if ctx is not None else b""
+                for ctx in (plane.peek_link(cmd.request_id) for cmd in cmds))
+            if any(encoded):
+                qos_wire = encoded
+        if qos_wire is not None:
+            self._send(addr, ClientCommitBatchQos(cmds, self.name, qos_wire))
+        elif len(cmds) == 1:
             self._send(addr, ClientCommit(cmds[0], self.name))
         else:
             self._send(addr, ClientCommitBatch(cmds, self.name))
@@ -730,6 +758,17 @@ class RaftMember:
             # Coalesced: flush_appends()/tick() broadcasts once per round,
             # covering every command submitted in the burst.
             self._append_dirty = True
+            if _qos.ACTIVE is not None and self._qos_should_seal():
+                # Deadline-aware group commit (queueing point 3 of the QoS
+                # plane): an interactive entry in the round's buffer is
+                # about to breach its SLO deadline — seal and replicate NOW
+                # instead of waiting for the scheduling round to close.
+                self.metrics["qos_early_seals"] += 1
+                if _obs.ACTIVE is not None:
+                    mark = _obs.now()
+                    _obs.record("qos_flush", mark, mark,
+                                attrs={"point": "raft_seal"})
+                self.flush_appends()
         elif self.leader_name is not None and self.leader_name in self.peers:
             # Buffered: tick()/flush_appends() forwards the round's commands
             # in one ClientCommitBatch frame.
@@ -737,6 +776,22 @@ class RaftMember:
         else:
             self.decided[command.request_id] = ClientReply(
                 command.request_id, False, None, self.leader_name)
+
+    def _qos_should_seal(self) -> bool:
+        """True when some buffered command's QoS context (link map filled
+        by commit_async locally or by ClientCommitBatchQos for forwarded
+        commands) is an interactive entry near its deadline. The deadline
+        evaluation — the only clock read — lives in the QoS plane, never
+        here: consensus code stays wall-clock-free (the no-wallclock-in-
+        apply invariant)."""
+        plane = _qos.ACTIVE
+        if plane is None or not self._pending_batch:
+            return False
+        for cmd in self._pending_batch:
+            qctx = plane.peek_link(cmd.request_id)
+            if qctx is not None and plane.near_deadline(qctx):
+                return True
+        return False
 
     # -- message handling --------------------------------------------------
 
@@ -785,6 +840,14 @@ class RaftMember:
             self._on_client_commit(payload)
         elif isinstance(payload, ClientCommitBatch):
             for cmd in payload.commands:
+                self._on_client_commit(ClientCommit(cmd, payload.reply_to))
+        elif isinstance(payload, ClientCommitBatchQos):
+            plane = _qos.ACTIVE
+            for cmd, qw in zip(payload.commands, payload.qos):
+                if plane is not None and qw:
+                    qctx = _qos.QosContext.from_wire(qw)
+                    if qctx is not None:
+                        plane.register_link(cmd.request_id, qctx)
                 self._on_client_commit(ClientCommit(cmd, payload.reply_to))
         elif isinstance(payload, ClientReply):
             self._record_decision(payload.request_id, payload)
@@ -1217,6 +1280,8 @@ class RaftMember:
                                         outcome, self.leader_name)
                 self._record_decision(cmd.request_id, reply)
                 self._appending.discard(cmd.request_id)
+                if _qos.ACTIVE is not None:
+                    _qos.ACTIVE.pop_link(cmd.request_id)
                 fwd = getattr(self, "_forward_replies", {}).pop(
                     cmd.request_id, None)
                 if fwd is not None and self._peer_addr(fwd) is not None:
@@ -1276,6 +1341,9 @@ class RaftMember:
                                      if frames else None),
             "forward_frames": m["forward_frames"],
             "forward_commands": m["forward_commands"],
+            # QoS plane: scheduling rounds sealed early because a buffered
+            # interactive entry neared its SLO deadline (0 when disarmed).
+            "qos_early_seals": m["qos_early_seals"],
             "replication_rtt_ms_avg": (
                 round(1e3 * m["replication_rtt_s"] / rtt_n, 3)
                 if rtt_n else None),
@@ -1339,6 +1407,12 @@ class RaftUniquenessProvider(UniquenessProvider):
             # consensus API. t0 anchors the per-tx raft_commit span.
             _obs.register_link(request_id, ctx[0], ctx[1])
             state["trace_t0"] = _obs.now()
+        qctx = _qos.get_context() if _qos.ACTIVE is not None else None
+        if qctx is not None:
+            # QoS link map, same shape as the trace link: lets the leader's
+            # deadline-aware seal (and a forwarding follower) see this
+            # request's lane/deadline without widening the consensus API.
+            _qos.ACTIVE.register_link(request_id, qctx)
 
         def poll():
             now = _time.monotonic()
@@ -1354,6 +1428,8 @@ class RaftUniquenessProvider(UniquenessProvider):
                         _obs.now(), trace_id=ctx[0], parent=ctx[1],
                         attrs={"ok": bool(reply.ok)})
                     _obs.pop_link(request_id)
+                if decided and _qos.ACTIVE is not None:
+                    _qos.ACTIVE.pop_link(request_id)
                 if reply.ok:
                     return True
                 if reply.conflict is not None:

@@ -66,6 +66,7 @@ from typing import Any, Callable, Sequence
 from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
 from ...obs import trace as _obs
+from ...qos import context as _qos
 from .api import UniquenessException, UniquenessProvider
 from .raft import (
     AbortReservedCommand,
@@ -259,6 +260,11 @@ class ShardedUniquenessProvider(UniquenessProvider):
         if ctx is not None:
             _obs.register_link(op["rid"], ctx[0], ctx[1])
             t0 = _obs.now()
+        qctx = _qos.get_context() if _qos.ACTIVE is not None else None
+        if qctx is not None:
+            # QoS link beside the trace link: the owning group's leader
+            # sees the lane/deadline when deciding whether to seal early.
+            _qos.ACTIVE.register_link(op["rid"], qctx)
 
         def make_command(op):
             return PutAllCommand(
@@ -277,6 +283,8 @@ class ShardedUniquenessProvider(UniquenessProvider):
                                 trace_id=ctx[0], parent=ctx[1],
                                 attrs={"ok": True, "remote_group": group})
                     _obs.pop_link(op["rid"])
+                if qctx is not None and _qos.ACTIVE is not None:
+                    _qos.ACTIVE.pop_link(op["rid"])
                 return True
             if now >= deadline:
                 raise CommitTimeoutException(
@@ -298,6 +306,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
         if ctx is not None:
             for op in state["ops"].values():
                 _obs.register_link(op["rid"], ctx[0], ctx[1])
+        qctx = _qos.get_context() if _qos.ACTIVE is not None else None
+        if qctx is not None:
+            for op in state["ops"].values():
+                _qos.ACTIVE.register_link(op["rid"], qctx)
 
         def reserve_command(op):
             return ReserveCommand(
@@ -360,6 +372,9 @@ class ShardedUniquenessProvider(UniquenessProvider):
                     if ctx is not None:
                         for op in state["ops"].values():
                             _obs.register_link(op["rid"], ctx[0], ctx[1])
+                    if qctx is not None and _qos.ACTIVE is not None:
+                        for op in state["ops"].values():
+                            _qos.ACTIVE.register_link(op["rid"], qctx)
                     return None
                 _record_phase("shard_commit")
                 return True

@@ -56,6 +56,7 @@ from ..flows.api import (
     flow_registry,
 )
 from ..obs import trace as _obs
+from ..qos import context as _qos
 from ..serialization.codec import deserialize, register, serialize
 from ..serialization.tokens import TokenContext
 from ..testing import faults as _faults
@@ -355,6 +356,9 @@ class FlowStateMachine:
         self.trace_span: bytes | None = None
         self.trace_parent: bytes | None = None
         self.trace_t0: float = 0.0  # epoch seconds (cross-process merge)
+        # QoS context (qos/context.py): None while the plane is disarmed or
+        # the flow is unlabelled; set at add() or joined at SessionInit.
+        self.qos = None
         logic.state_machine = self
         logic.service_hub = manager.service_hub
 
@@ -462,16 +466,25 @@ class FlowStateMachine:
         the manager's pump (single-threaded)."""
         if self.state == _DONE:
             return
-        if _obs.ACTIVE is not None and self.trace_id is not None:
-            # Everything this flow does while stepping — session sends,
-            # service submissions — inherits its trace context.
-            _obs.set_context(self.trace_id, self.trace_span)
-            try:
+        qos_armed = _qos.ACTIVE is not None and self.qos is not None
+        if qos_armed:
+            # Session sends and service submissions this step makes carry
+            # the flow's lane + deadline, exactly like trace context.
+            _qos.set_context(self.qos)
+        try:
+            if _obs.ACTIVE is not None and self.trace_id is not None:
+                # Everything this flow does while stepping — session sends,
+                # service submissions — inherits its trace context.
+                _obs.set_context(self.trace_id, self.trace_span)
+                try:
+                    self._step_inner()
+                finally:
+                    _obs.clear_context()
+            else:
                 self._step_inner()
-            finally:
-                _obs.clear_context()
-        else:
-            self._step_inner()
+        finally:
+            if qos_armed:
+                _qos.clear_context()
 
     def _step_inner(self) -> None:
         try:
@@ -776,6 +789,12 @@ class StateMachineManager:
         self._verify_queue: list[tuple[FlowStateMachine, VerifyTxRequest]] = []
         self._verify_sig_count = 0
         self._verify_waiting_since = 0.0
+        # QoS plane (qos/context.py), all inert while disarmed: pump pick
+        # counter for the bulk anti-starvation ratio, and the earliest
+        # interactive deadline among queued verify jobs (epoch ns, 0 =
+        # none) driving the run loop's early micro-batch flush.
+        self._qos_pick_counter = 0
+        self._verify_qos_deadline_ns = 0
         self._service_queue: list[tuple[FlowStateMachine, Callable]] = []
         # Async verify pipeline (crypto/async_verify.AsyncVerifyService),
         # installed by the node assembly when batch.async_verify is on;
@@ -829,7 +848,7 @@ class StateMachineManager:
         (reference: ServiceHubInternal.registerFlowInitiator)."""
         self._flow_factories[initiator_flow_name] = factory
 
-    def add(self, logic: FlowLogic) -> FlowHandle:
+    def add(self, logic: FlowLogic, qos=None) -> FlowHandle:
         """Start a new flow (reference: StateMachineManager.kt:381-397)."""
         # Random run ids: a counter would restart at 0 after a crash and
         # collide with checkpoint-restored flows.
@@ -841,6 +860,18 @@ class StateMachineManager:
             fsm.trace_id = _obs.new_trace_id()
             fsm.trace_span = _obs.new_span_id()
             fsm.trace_t0 = _obs.now()
+        if _qos.ACTIVE is not None:
+            # Explicit lane wins; otherwise inherit the starting thread's
+            # context (a flow started from inside another flow's step
+            # shares its lane, same semantics as sub-flows).
+            if qos is None:
+                qos = _qos.get_context()
+            fsm.qos = qos
+            if qos is not None:
+                lane_key = (f"{qos.lane}_flows"
+                            if qos.lane in _qos.LANES else None)
+                if lane_key is not None:
+                    _qos.ACTIVE.counters[lane_key] += 1
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
         self._subscribe_progress(logic, run_id)
@@ -975,6 +1006,52 @@ class StateMachineManager:
         if fsm not in self._runnable and fsm.state != _DONE:
             fsm.state = _RUNNABLE
             self._runnable.append(fsm)
+            if (_qos.ACTIVE is not None and fsm.qos is not None
+                    and _obs.ACTIVE is not None
+                    and fsm.trace_id is not None):
+                # Stamp for the lane_queue_wait span closed at pick time.
+                fsm.qos_runnable_since = _obs.now()
+
+    def _next_runnable(self) -> FlowStateMachine:
+        """Pop the next flow step. Disarmed: strict FIFO (pop(0)), the
+        pre-QoS behaviour. Armed: interactive and unlabelled flows form
+        one priority class served FIFO ahead of bulk, with every
+        ``bulk_every``'th pick taking the oldest bulk step when both
+        classes are runnable (anti-starvation) — so a tree that never
+        marks a lane still schedules in exact FIFO order."""
+        plane = _qos.ACTIVE
+        if plane is None:
+            return self._runnable.pop(0)
+        runnable = self._runnable
+        pri_idx = bulk_idx = None
+        for i, fsm in enumerate(runnable):
+            ctx = fsm.qos
+            if ctx is not None and ctx.lane == _qos.LANE_BULK:
+                if bulk_idx is None:
+                    bulk_idx = i
+            elif pri_idx is None:
+                pri_idx = i
+            if pri_idx is not None and bulk_idx is not None:
+                break
+        if pri_idx is None or bulk_idx is None:
+            idx = 0  # one class present: FIFO
+        else:
+            self._qos_pick_counter += 1
+            if self._qos_pick_counter % plane.bulk_every == 0:
+                idx = bulk_idx
+                plane.counters["bulk_antistarvation_picks"] += 1
+            else:
+                idx = pri_idx
+        fsm = runnable.pop(idx)
+        since = getattr(fsm, "qos_runnable_since", None)
+        if since is not None:
+            fsm.qos_runnable_since = None
+            if (_obs.ACTIVE is not None and fsm.trace_id is not None
+                    and fsm.qos is not None):
+                _obs.record("lane_queue_wait", since, _obs.now(),
+                            trace_id=fsm.trace_id, parent=fsm.trace_span,
+                            attrs={"lane": fsm.qos.lane})
+        return fsm
 
     def _pump(self) -> None:
         """Run flows until everything is parked; then flush verify batches.
@@ -985,7 +1062,7 @@ class StateMachineManager:
         try:
             while True:
                 while self._runnable:
-                    fsm = self._runnable.pop(0)
+                    fsm = self._next_runnable()
                     if fsm.state != _DONE:
                         fsm.step()
                 if self._verify_queue and not self.defer_verify:
@@ -1016,6 +1093,13 @@ class StateMachineManager:
             # Stamp when this flow's request joined the micro-batch; the
             # verify_wait span closes when the batch flushes/submits.
             fsm.trace_verify_enq = _obs.now()
+        if _qos.ACTIVE is not None:
+            ctx = fsm.qos
+            if (ctx is not None and ctx.lane == _qos.LANE_INTERACTIVE
+                    and ctx.deadline_ns > 0
+                    and (self._verify_qos_deadline_ns == 0
+                         or ctx.deadline_ns < self._verify_qos_deadline_ns)):
+                self._verify_qos_deadline_ns = ctx.deadline_ns
         self._verify_queue.append((fsm, request))
         if isinstance(request, VerifySigRequest):
             self._verify_sig_count += 1
@@ -1034,6 +1118,33 @@ class StateMachineManager:
     def verify_waiting_since(self) -> float:
         """monotonic() when the current micro-batch started accumulating."""
         return self._verify_waiting_since
+
+    def verify_deadline_pressure(self) -> bool:
+        """True when the earliest interactive deadline in the verify
+        micro-batch is within the QoS guard window — the run loop flushes
+        early instead of waiting out max_wait_ms (deadline-aware
+        coalescing at queueing point 1 of 3)."""
+        plane = _qos.ACTIVE
+        if plane is None or not self._verify_queue:
+            return False
+        return plane.deadline_near_ns(self._verify_qos_deadline_ns)
+
+    def qos_queue_depth(self) -> int:
+        """Runnable backlog the admission watermark judges bulk against:
+        ready flow steps + flows parked on a service poll (commit in
+        flight) — the work interactive requests must traverse."""
+        return len(self._runnable) + len(self._service_queue)
+
+    def _qos_verify_hint(self) -> None:
+        """Advisory (lane, deadline_ns) for the verifier client: a sidecar
+        verifier forwards it on the wire so the SERVER's scheduler can
+        deadline-flush across processes. Reset with the micro-batch."""
+        plane = _qos.ACTIVE
+        if plane is None:
+            return
+        dl = self._verify_qos_deadline_ns
+        self.verifier.qos_hint = (
+            (_qos.LANE_INTERACTIVE, dl) if dl > 0 else None)
 
     # -- async service polling (Raft commit etc.) --------------------------
 
@@ -1058,6 +1169,7 @@ class StateMachineManager:
         done = 0
         still_pending = []
         traced = _obs.ACTIVE is not None
+        qos_armed = _qos.ACTIVE is not None
         for fsm, poll in self._service_queue:
             if fsm.state != _WAIT_SERVICE:  # flow died/was restored elsewhere
                 continue
@@ -1065,6 +1177,11 @@ class StateMachineManager:
                 # commit_async submissions inside poll() must carry the
                 # submitting flow's context (raft link registration).
                 _obs.set_context(fsm.trace_id, fsm.trace_span)
+            if qos_armed:
+                # Same rule for the QoS link: a (re)submission this poll
+                # makes must register under ITS flow's lane/deadline, so
+                # set-or-clear per iteration, never inherit a neighbour's.
+                _qos.set_context(fsm.qos)
             try:
                 outcome = poll()
             except Exception as e:
@@ -1078,6 +1195,8 @@ class StateMachineManager:
                 done += 1
         if traced:
             _obs.clear_context()
+        if qos_armed:
+            _qos.clear_context()
         self._service_queue = still_pending
         self.metrics["service_polls"] += 1
         if done:
@@ -1090,8 +1209,10 @@ class StateMachineManager:
     def _flush_verify_batch(self) -> None:
         """One batched kernel call covering every parked VerifyTxRequest and
         VerifySigRequest (the synchronous path: verify on THIS thread)."""
+        self._qos_verify_hint()
         batch, self._verify_queue = self._verify_queue, []
         self._verify_sig_count = 0
+        self._verify_qos_deadline_ns = 0
         if _obs.ACTIVE is not None:
             self._record_verify_wait(batch)
         jobs, spans = self._build_verify_jobs(batch)
@@ -1186,8 +1307,10 @@ class StateMachineManager:
         flush_pending_verifies); returns the number of jobs submitted.
         The parked flows stay in _WAIT_VERIFY until drain_async_verifies
         delivers the completed batch on a later round."""
+        self._qos_verify_hint()
         batch, self._verify_queue = self._verify_queue, []
         self._verify_sig_count = 0
+        self._verify_qos_deadline_ns = 0
         if not batch:
             return 0
         if _obs.ACTIVE is not None:
@@ -1313,6 +1436,10 @@ class StateMachineManager:
             fsm.trace_id, fsm.trace_parent = message.trace
             fsm.trace_span = _obs.new_span_id()
             fsm.trace_t0 = _obs.now()
+        if _qos.ACTIVE is not None and message.qos is not None:
+            # Join the initiator's lane + deadline: the responder (the
+            # notary) schedules this flow under the CLIENT's contract.
+            fsm.qos = message.qos
         self.flows[run_id] = fsm
         self.metrics["started"] += 1
         local_id = fsm._session_id(fsm.next_session_seq)

@@ -93,6 +93,13 @@ class SidecarVerifier(DeviceRoutedVerifier):
         self.last_wait_s: float | None = None
         self.last_verify_s: float | None = None
         self.last_tier: str | None = None
+        # QoS hint: (lane_code, deadline_ns) set by the SMM right before a
+        # flush when the queued micro-batch contains an interactive request
+        # with a live deadline. Advisory and racy-by-design — a stale hint
+        # costs one early server flush, never correctness. When set, the
+        # next batch ships as OP_VERIFY_QOS so the sidecar's deadline
+        # scheduler can order/flush around it.
+        self.qos_hint: tuple[int, int] | None = None
 
     # -- routing ------------------------------------------------------------
 
@@ -140,8 +147,14 @@ class SidecarVerifier(DeviceRoutedVerifier):
                 self._req_id += 1
                 req_id = self._req_id
                 sock.settimeout(max(0.05, deadline - time.perf_counter()))
-                wire.send_frame(sock,
-                                wire.encode_verify_request(req_id, good))
+                hint = self.qos_hint
+                if hint is not None:
+                    lane_code, deadline_ns = hint
+                    req = wire.encode_verify_request_qos(
+                        req_id, good, lane_code, deadline_ns)
+                else:
+                    req = wire.encode_verify_request(req_id, good)
+                wire.send_frame(sock, req)
                 while True:
                     sock.settimeout(max(0.05,
                                         deadline - time.perf_counter()))

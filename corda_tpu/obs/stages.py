@@ -43,20 +43,27 @@ BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
 
 # Per-trace measured stage spans. shard_reserve/shard_commit are the two
 # phases of the cross-shard 2PC coordinator (node/services/sharding.py).
-DIRECT_STAGES = ("verify_wait", "shard_reserve", "shard_commit")
+# admission_wait is the client-side backoff park after an OverloadedError
+# shed (flows/notary.py); lane_queue_wait is time spent runnable behind
+# the QoS lane scheduler before the pump picked the flow (statemachine).
+DIRECT_STAGES = ("verify_wait", "admission_wait", "lane_queue_wait",
+                 "shard_reserve", "shard_commit")
 
 # Derived by stage_breakdown, never recorded: the reply tail is
 # root_end - max(attributed stage end).
 DERIVED_STAGES = ("reply",)
 
 # Full breakdown order the bench report presents.
-STAGES = ("queue_wait", "verify_wait", "device_verify", "sidecar_wait",
-          "sidecar_verify", "shard_reserve", "shard_commit",
+STAGES = ("admission_wait", "queue_wait", "lane_queue_wait", "verify_wait",
+          "device_verify", "sidecar_wait", "sidecar_verify",
+          "shard_reserve", "shard_commit",
           "raft_append", "fsync", "replication", "reply")
 
 # Stitch markers: recorded per trace to bound the derived reply tail and
 # anchor cross-node correlation, but not themselves breakdown stages.
-MARKER_SPANS = ("raft_commit", "notary_process")
+# qos_flush marks a deadline-triggered early flush/seal at one of the
+# three QoS queueing points (attrs["point"] names which).
+MARKER_SPANS = ("raft_commit", "notary_process", "qos_flush")
 
 # Dynamic span families: a recorded name may start with one of these
 # prefixes (the root flow span is f"flow:{FlowClassName}").

@@ -68,6 +68,11 @@ class FirehoseResult:
     # committed (the exactly-once audit needs both sides of the ratio).
     cross_requested: int = 0
     cross_committed: int = 0
+    # QoS lane this firehose ran on ("" = unlabelled) and how many of its
+    # rejections were admission-control sheds (OverloadedError) — the SLO
+    # sweep separates shed load from genuine conflicts with these.
+    lane: str = ""
+    shed: int = 0
 
 
 class _Firehose:
@@ -99,6 +104,7 @@ class _Firehose:
         self.done = 0
         self.committed = 0
         self.rejected = 0
+        self.shed = 0
         self.cross_requested = 0
         self.cross_committed = 0
         self.sigs_signed = 0
@@ -204,21 +210,38 @@ class _Firehose:
             return None  # still preparing; the clock has not started
         if self.t0 is None:
             self.t0 = time.perf_counter()
+        from ..qos import context as _qos
+
+        lane = getattr(self.flow, "lane", "")
+        plane = _qos.ACTIVE
         for _ in range(self._admit_quota()):
             stx, via, cross = self.corpus[self.started]
             self.started += 1
             submitted = time.perf_counter()
-            handle = self.smm.add(NotaryClientFlow(stx, via=via))
+            # Lane-labelled load: each tx gets a fresh QosContext stamped
+            # admitted-now (interactive derives its deadline from slo_ms),
+            # so the whole QoS plane sees this firehose's class. Unlabelled
+            # (lane="" or plane disarmed) starts exactly as before.
+            qctx = (plane.new_context(
+                        lane, getattr(self.flow, "slo_ms", 0.0) or None)
+                    if plane is not None and lane else None)
+            handle = self.smm.add(NotaryClientFlow(stx, via=via), qos=qctx)
 
             def on_done(future, t=submitted, cross=cross):
                 self.done += 1
                 self.latencies.append(time.perf_counter() - t)
-                if future.exception() is None:
+                exc = future.exception()
+                if exc is None:
                     self.committed += 1
                     if cross:
                         self.cross_committed += 1
                 else:
                     self.rejected += 1
+                    from ..flows.notary import OverloadedError
+
+                    if isinstance(getattr(exc, "error", None),
+                                  OverloadedError):
+                        self.shed += 1
 
             handle.result.add_done_callback(on_done)
         if self.done < self.flow.n_tx:
@@ -242,6 +265,8 @@ class _Firehose:
             sigs_signed=self.sigs_signed,
             cross_requested=self.cross_requested,
             cross_committed=self.cross_committed,
+            lane=getattr(self.flow, "lane", ""),
+            shed=self.shed,
         )
 
 
@@ -255,12 +280,18 @@ class FirehoseFlow(FlowLogic):
     route to their owning group via the netmap shard directory."""
 
     def __init__(self, n_tx: int, width: int = 1, inflight: int = 64,
-                 rate_tx_s: float = 0.0, cross_frac: float = 0.0):
+                 rate_tx_s: float = 0.0, cross_frac: float = 0.0,
+                 lane: str = "", slo_ms: float = 0.0):
         self.n_tx = n_tx
         self.width = width
         self.inflight = inflight
         self.rate_tx_s = rate_tx_s
         self.cross_frac = cross_frac
+        # QoS lane for every generated tx ("interactive"/"bulk"; "" starts
+        # them unlabelled) and the interactive SLO override in ms (0 uses
+        # the armed plane's default).
+        self.lane = lane
+        self.slo_ms = slo_ms
 
     def call(self):
         result = yield self.service_request(lambda: _Firehose(self).poll)

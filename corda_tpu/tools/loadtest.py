@@ -712,6 +712,11 @@ def run_loadtest_multiprocess(
     cross_frac: float = 0.0,  # fraction of txs built to span two shards
     # (the 2PC path); 0 = single-shard-only mix
     reserve_ttl_s: float = 15.0,  # cross-shard reservation TTL
+    lane: str = "",  # QoS lane label for every firehose tx ("interactive"
+    # or "bulk"); non-empty arms the QoS plane on every node. "" keeps the
+    # run bit-identical to the pre-QoS harness.
+    slo_ms: float = 50.0,  # interactive SLO (deadline per tx) when a lane
+    # is set; ignored otherwise
 ) -> MultiProcessResult:
     """The reference-shaped harness: every node is a REAL OS process (its own
     GIL, transport sockets, sqlite), the coordinator only starts firehoses
@@ -732,6 +737,8 @@ def run_loadtest_multiprocess(
             out += f"sidecar = {json.dumps(sidecar_addr)}\n"
             if sidecar_devices:
                 out += f"sidecar_devices = {int(sidecar_devices)}\n"
+        if lane:
+            out += f"[qos]\nenabled = true\nslo_ms = {float(slo_ms)}\n"
         return out
 
     disruptions: list[str] = []
@@ -844,10 +851,12 @@ def run_loadtest_multiprocess(
         before = [r.call("node_metrics") for r in rpcs + member_rpcs]
         t_start = time.perf_counter()
         per_client_n = n_tx // clients
+        flow_args = (per_client_n, width, inflight, float(rate_tx_s),
+                     float(cross_frac))
+        if lane:  # unlabelled runs keep the pre-QoS start_flow arg shape
+            flow_args += (lane, float(slo_ms))
         flow_handles = [
-            r.call("start_flow_dynamic", "loadgen.FirehoseFlow",
-                   (per_client_n, width, inflight, float(rate_tx_s),
-                    float(cross_frac)))
+            r.call("start_flow_dynamic", "loadgen.FirehoseFlow", flow_args)
             for r in rpcs]
         results: list = [None] * clients
         deadline = time.monotonic() + max_seconds
@@ -1008,6 +1017,9 @@ class SweepResult:
     # Server-side verification-sidecar stats for the whole sweep
     # (crypto/sidecar.py stats()); None when the sweep ran without one.
     sidecar: dict | None = None
+    # Per-member QoS plane + admission-controller stats (rpc node_metrics
+    # "qos"/"admission") when the sweep ran with the plane armed.
+    qos: dict | None = None
 
     def __getitem__(self, rate):
         return self.results[rate]
@@ -1054,6 +1066,8 @@ def _merge_firehose(values: list):
                             for v in values),
         cross_committed=sum(getattr(v, "cross_committed", 0)
                             for v in values),
+        lane=getattr(values[0], "lane", ""),
+        shed=sum(getattr(v, "shed", 0) for v in values),
     )
 
 
@@ -1217,6 +1231,7 @@ def run_latency_sweep(
             try:
                 stamps[m.name] = _member_stamp(
                     r.call("node_metrics"), m.device)
+            # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep
         if side is not None:
@@ -1232,6 +1247,168 @@ def run_latency_sweep(
                 _write_trace(trace, snapshots)
     return SweepResult(results=results, node_stamps=stamps,
                        trace_snapshots=snapshots, sidecar=side_stats)
+
+
+def run_slo_sweep(
+    rates: tuple[float, ...] = (60.0, 120.0, 240.0),
+    n_tx: int = 240,
+    width: int = 4,
+    clients: int = 2,
+    interactive_frac: float = 0.25,  # share of each offered load (and of
+    # n_tx) labelled interactive; the rest runs on the bulk lane
+    slo_ms: float = 50.0,  # the explicit SLO: interactive deadline per tx
+    bulk_rate: float = 0.0,  # bulk admission bucket (tx/s; 0 = unlimited,
+    # the watermark alone does the shedding)
+    queue_watermark: int = 48,  # runnable-backlog depth above which the
+    # notary sheds BULK (interactive is never watermark-shed)
+    notary: str = "simple",  # simple | validating | raft | raft-validating
+    cluster_size: int = 3,
+    verifier: str = "cpu",
+    notary_device: str = "cpu",
+    max_sigs: int = 4096,
+    max_wait_ms: float = 2.0,
+    coalesce_ms: float = 0.0,
+    base_dir: str | None = None,
+    max_seconds: float = 300.0,
+    async_verify: bool = True,
+    async_depth: int = 2,
+    sidecar: bool = False,
+    sidecar_coalesce_us: int = 2000,
+    sidecar_devices: int = 0,
+    qos: bool = True,  # False: the SAME mixed-lane offered load through an
+    # unarmed plane — the no-QoS baseline the SLO verdict compares against
+) -> SweepResult:
+    """Mixed-lane open-loop sweep for the explicit p99 SLO verdict: at each
+    offered load, every client process drives TWO concurrent firehoses —
+    one interactive (lane-labelled, deadline = slo_ms) at
+    ``rate * interactive_frac`` and one bulk at the remainder — so the
+    notary sees a contended mix, not a single-class stream. Per-lane
+    FirehoseResults (p50/p99, committed, shed) are merged across clients;
+    results[rate] is ``{"interactive": FirehoseResult, "bulk": ...}``.
+
+    With ``qos=True`` every node arms the plane ([qos] in its TOML): lanes
+    reorder the runnable queue, deadlines early-flush the three batching
+    points, and the notary's admission controller watermark-sheds bulk —
+    the claim under test is that interactive p99 stays inside slo_ms while
+    bulk absorbs the overload as sheds. With ``qos=False`` the same load
+    runs bit-identical to the pre-QoS tree and both lanes collapse
+    together — the baseline."""
+    from ..testing.driver import driver
+
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-slo-"))
+
+    def _extra(v: str, sidecar_addr: str = "") -> str:
+        out = (f'verifier = "{v}"\n'
+               f"[batch]\nmax_sigs = {max_sigs}\n"
+               f"max_wait_ms = {max_wait_ms}\n"
+               f"coalesce_ms = {coalesce_ms}\n"
+               f"async_verify = {str(async_verify).lower()}\n"
+               f"async_depth = {async_depth}\n")
+        if sidecar_addr:
+            out += f"sidecar = {json.dumps(sidecar_addr)}\n"
+            if sidecar_devices:
+                out += f"sidecar_devices = {int(sidecar_devices)}\n"
+        if qos:
+            # Arms the plane in EVERY node process: clients stamp lane
+            # contexts onto generated txs, members schedule/shed by them.
+            out += (f"[qos]\nenabled = true\n"
+                    f"slo_ms = {float(slo_ms)}\n"
+                    f"bulk_rate = {float(bulk_rate)}\n"
+                    f"queue_watermark = {int(queue_watermark)}\n")
+        return out
+
+    results: dict = {}
+    stamps: dict = {}
+    qstats: dict = {}
+    side_stats = None
+    lanes = (("interactive", float(interactive_frac), float(slo_ms)),
+             ("bulk", 1.0 - float(interactive_frac), 0.0))
+    with driver(base) as d:
+        side = None
+        if sidecar:
+            side = d.start_sidecar(
+                verifier=verifier, device=notary_device,
+                coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
+                devices=sidecar_devices or None)
+        side_addr = side.address if side is not None else ""
+        members = _start_notary_processes(
+            d, notary, cluster_size, _extra(verifier, side_addr),
+            follower_extra=_extra("cpu", side_addr), device=notary_device,
+            rpc=True)
+        member_rpcs = []
+        for m in members:
+            member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
+            d.defer(member_rpcs[-1].close)
+        clients = max(1, clients)
+        client_rpcs = []
+        for i in range(clients):
+            handle = d.start_node(f"Client{i}", rpc=True,
+                                  cordapps=("corda_tpu.tools.loadgen",),
+                                  extra_toml=_extra("cpu"))
+            client_rpcs.append(handle.rpc("demo", "s3cret", timeout=60.0))
+            d.defer(client_rpcs[-1].close)
+        # Same warm-up as the latency sweep: session establishment and
+        # first-contact paths run OUTSIDE the measured rates.
+        warms = [r.call("start_flow_dynamic", "loadgen.FirehoseFlow",
+                        (5, width, 5, 0.0)) for r in client_rpcs]
+        deadline = time.monotonic() + max_seconds
+        pending = list(zip(client_rpcs, warms))
+        while pending and time.monotonic() < deadline:
+            pending = [(r, w) for r, w in pending
+                       if not r.call("flow_result", w.run_id)[0]]
+            time.sleep(0.1)
+        if pending:
+            raise TimeoutError("SLO-sweep warmup did not finish")
+        for rate in rates:
+            # Two firehoses per client — the lanes CONTEND inside each
+            # client process and at the notary, which is the point.
+            fhs = []
+            for lane, frac, lane_slo in lanes:
+                ln = max(1, int(round(n_tx * frac)) // clients)
+                lane_rate = float(rate) * frac / clients
+                for r in client_rpcs:
+                    fhs.append((r, r.call(
+                        "start_flow_dynamic", "loadgen.FirehoseFlow",
+                        (ln, width, 1 << 30, lane_rate, 0.0,
+                         lane, lane_slo)), lane))
+            values: list = [None] * len(fhs)
+            deadline = time.monotonic() + max_seconds
+            while time.monotonic() < deadline:
+                for i, (r, fh, _) in enumerate(fhs):
+                    if values[i] is None:
+                        done, value = r.call("flow_result", fh.run_id)
+                        if done:
+                            values[i] = value
+                if all(v is not None for v in values):
+                    break
+                time.sleep(0.25)
+            else:
+                raise TimeoutError(
+                    f"SLO sweep at {rate} tx/s did not finish "
+                    f"in {max_seconds}s")
+            by_lane: dict = {}
+            for (_, _, lane), v in zip(fhs, values):
+                by_lane.setdefault(lane, []).append(v)
+            results[rate] = {lane: _merge_firehose(vs)
+                             for lane, vs in by_lane.items()}
+        for m, r in zip(members, member_rpcs):
+            try:
+                metrics = r.call("node_metrics")
+                stamps[m.name] = _member_stamp(metrics, m.device)
+                qstats[m.name] = {"qos": metrics.get("qos"),
+                                  "admission": metrics.get("admission")}
+            # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
+            except Exception:
+                pass  # a dead member costs its stamp, not the sweep
+        if side is not None:
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            try:
+                side_stats = fetch_sidecar_stats(side.address)
+            except SidecarError:
+                side_stats = {"error": "sidecar unreachable at gather"}
+    return SweepResult(results=results, node_stamps=stamps,
+                       sidecar=side_stats, qos=qstats or None)
 
 
 def main(argv=None) -> int:
@@ -1295,6 +1472,22 @@ def main(argv=None) -> int:
     ap.add_argument("--cross-frac", type=float, default=0.0,
                     help="fraction of transactions spanning two shards "
                          "(the two-phase commit path)")
+    ap.add_argument("--lane", choices=("interactive", "bulk"), default="",
+                    help="QoS lane label for every firehose transaction "
+                         "(--processes mode); arms the QoS plane on every "
+                         "node (qos/context.py). Omit for the unlabelled, "
+                         "bit-identical pre-QoS run")
+    ap.add_argument("--slo-ms", type=float, default=50.0,
+                    help="interactive SLO in ms: each interactive tx "
+                         "carries deadline = admit + slo_ms, which the "
+                         "plane's three batching points flush against "
+                         "(with --lane or --offered-load)")
+    ap.add_argument("--offered-load", default=None, metavar="R1,R2,..",
+                    help="run the mixed-lane SLO sweep instead of a single "
+                         "burst: at each offered load (tx/s, comma list) "
+                         "every client drives an interactive AND a bulk "
+                         "firehose concurrently; prints per-lane p50/p99, "
+                         "committed and shed counts plus member QoS stats")
     args = ap.parse_args(argv)
     if args.shards and not args.processes:
         ap.error("--shards requires --processes (each shard group is a "
@@ -1305,6 +1498,27 @@ def main(argv=None) -> int:
     if args.sidecar_devices and not args.sidecar:
         ap.error("--sidecar-devices requires --sidecar (the mesh lives "
                  "inside the sidecar server)")
+    if args.lane and not args.processes:
+        ap.error("--lane requires --processes (the QoS plane spans real "
+                 "node processes; in-process mode has no lane plumbing)")
+    if args.offered_load:
+        sweep = run_slo_sweep(
+            rates=tuple(float(x) for x in args.offered_load.split(",")),
+            n_tx=args.tx, width=args.width, clients=args.clients,
+            slo_ms=args.slo_ms, notary=args.notary,
+            cluster_size=args.cluster_size, verifier=args.verifier,
+            notary_device=args.notary_device, max_sigs=args.max_sigs,
+            max_wait_ms=args.max_wait_ms, sidecar=args.sidecar,
+            sidecar_devices=args.sidecar_devices)
+        print(json.dumps({
+            "slo_ms": args.slo_ms,
+            "rates": {f"{rate:g}": {lane: dict(vars(fr))
+                                    for lane, fr in by_lane.items()}
+                      for rate, by_lane in sweep.items()},
+            "node_stamps": sweep.node_stamps,
+            "qos": sweep.qos,
+        }))
+        return 0
     if args.chaos is not None or args.kill_leader:
         result = run_chaos_loadtest(
             plan=args.chaos, n_tx=args.tx, cluster_size=args.cluster_size,
@@ -1322,7 +1536,8 @@ def main(argv=None) -> int:
             notary_device=args.notary_device,
             trace=args.trace, sidecar=args.sidecar,
             sidecar_devices=args.sidecar_devices,
-            shards=args.shards, cross_frac=args.cross_frac)
+            shards=args.shards, cross_frac=args.cross_frac,
+            lane=args.lane, slo_ms=args.slo_ms)
     else:
         result = run_loadtest(
             n_tx=args.tx, notary=args.notary,

@@ -25,6 +25,7 @@ def _stub_phases(monkeypatch):
                  # report test — minutes of suite time measuring nothing
                  "bench_shard_scaling",  # ditto: boots up to 4 raft groups
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
+                 "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -65,6 +66,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # mesh) AND the host-only path (virtual mesh) — same schema both ways.
     assert report["baseline_configs"]["multichip_scaling"] == {
         "stub": "bench_multichip_scaling"}
+    # The QoS SLO sweep rides the device phase path (sidecar-fed) — the
+    # host-only path asserts it separately; schema parity both ways.
+    assert report["baseline_configs"]["slo_sweep"] == {
+        "stub": "bench_slo_sweep"}
     assert "phase" not in report
 
 
@@ -122,6 +127,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_shard_scaling"}
     assert report["baseline_configs"]["multichip_scaling"] == {
         "stub": "bench_multichip_scaling"}
+    assert report["baseline_configs"]["slo_sweep"] == {
+        "stub": "bench_slo_sweep"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
 
 
@@ -399,6 +406,79 @@ def test_multichip_scaling_report_contract(monkeypatch):
     assert host["devices"]["4"] == {"error": "RuntimeError: mesh boot failed"}
     assert set(host["sigs_per_sec_by_devices"]) == {"1", "2"}
     assert "scaling_1_to_max" not in host  # max width errored: no ratio
+
+
+def test_slo_sweep_report_contract(monkeypatch):
+    """The slo_sweep section's one-line-JSON contract: per-lane p50/p99 at
+    every offered load for BOTH the armed run and the no-QoS baseline,
+    plus the explicit SLO verdict (interactive p99 within bound at the
+    ≥5×-flagship top rate while bulk sheds, baseline collapse ratio) —
+    trend tooling and the driver grep these keys flat, and the whole
+    section must survive json.dumps (FirehoseResults never leak through)."""
+    from corda_tpu.tools import loadtest
+    from corda_tpu.tools.loadgen import FirehoseResult
+
+    def fr(p99, shed=0, lane=""):
+        return FirehoseResult(
+            requested=120, committed=120 - shed, rejected=shed,
+            duration_s=2.0, tx_per_sec=60.0, p50_ms=p99 / 4, p90_ms=p99 / 2,
+            p99_ms=p99, width=4, sigs_signed=480, lane=lane, shed=shed)
+
+    calls = []
+
+    def fake_sweep(**kw):
+        calls.append(kw)
+        if kw["qos"]:  # armed: interactive flat, bulk shed under overload
+            results = {60.0: {"interactive": fr(40.0, lane="interactive"),
+                              "bulk": fr(60.0, lane="bulk")},
+                       240.0: {"interactive": fr(120.0, lane="interactive"),
+                               "bulk": fr(900.0, shed=35, lane="bulk")}}
+            return loadtest.SweepResult(
+                results=results,
+                node_stamps={"Notary": {"device_batches": 0}},
+                qos={"Notary": {"qos": {"interactive_flows": 30},
+                                "admission": {"shed_bulk": 35}}})
+        results = {60.0: {"interactive": fr(50.0, lane="interactive"),
+                          "bulk": fr(55.0, lane="bulk")},
+                   240.0: {"interactive": fr(2400.0, lane="interactive"),
+                           "bulk": fr(2500.0, lane="bulk")}}
+        return loadtest.SweepResult(results=results, node_stamps={})
+
+    monkeypatch.setattr(loadtest, "run_slo_sweep", fake_sweep)
+    out = bench.bench_slo_sweep(rates=(60.0, 240.0), slo_ms=250.0,
+                                flagship_tx_s=40.0)
+
+    json.dumps(out)  # the one-line contract: fully serializable
+    # Both runs happened, armed first, over the same rates.
+    assert [kw["qos"] for kw in calls] == [True, False]
+    assert calls[0]["rates"] == calls[1]["rates"] == (60.0, 240.0)
+    # Per-lane percentiles at every rate, both sections.
+    assert out["qos"]["240_tx_s"]["interactive"]["p99_ms"] == 120.0
+    assert out["qos"]["240_tx_s"]["bulk"]["shed"] == 35
+    assert out["no_qos_baseline"]["240_tx_s"]["interactive"]["p99_ms"] \
+        == 2400.0
+    # Member-side plane + admission stats ride along.
+    assert out["member_qos"]["Notary"]["admission"]["shed_bulk"] == 35
+    # The verdict: within bound at 6× flagship, bulk shed, baseline
+    # collapsed 20× worse.
+    v = out["verdict"]
+    assert v["offered_top_tx_s"] == 240.0
+    assert v["offered_over_flagship"] == 6.0
+    assert v["interactive_p99_within_slo"] is True
+    assert v["bulk_shed_nonzero"] is True
+    assert v["interactive_vs_baseline"] == 20.0
+    assert v["slo_met"] is True
+
+    # SLO breach shape: interactive p99 over the bound flips the verdict
+    # (the section reports the miss, it does not hide it).
+    monkeypatch.setattr(
+        loadtest, "run_slo_sweep",
+        lambda **kw: loadtest.SweepResult(results={
+            240.0: {"interactive": fr(900.0, lane="interactive"),
+                    "bulk": fr(950.0, lane="bulk")}}))
+    miss = bench.bench_slo_sweep(rates=(240.0,), slo_ms=250.0)
+    assert miss["verdict"]["interactive_p99_within_slo"] is False
+    assert miss["verdict"]["slo_met"] is False
 
 
 def test_verifier_stamp_reports_device_occupancy():

@@ -1,0 +1,582 @@
+"""The QoS plane (corda_tpu/qos/): priority lanes, admission control and
+deadline-aware coalescing.
+
+Covers the ISSUE acceptance list for the round-12 subsystem:
+
+* QosContext wire codec (17-byte <BQQ field; junk decodes to None, never
+  an exception) and the plane's arming/env-parsing/link-map-bound
+  behaviour, mirroring the obs/trace discipline;
+* AdmissionController token buckets + queue-depth watermark (bulk sheds,
+  interactive and unlabelled admit; retry-after is bounded);
+* SMM lane scheduling: interactive-first with the bulk_every
+  anti-starvation ratio, and the DISARMED path staying strict pop(0)
+  FIFO — the bit-identical guarantee;
+* deadline-aware early flush at all three queueing points: the SMM
+  verify micro-batch (verify_deadline_pressure + the sidecar hint), the
+  sidecar server's deadline scheduler (OP_VERIFY_QOS over a real unix
+  socket), and the Raft leader's group-commit seal;
+* overload shed + retry: a bulk client is shed with a retryable
+  OverloadedError, notarise_with_retry backs off, and the retry commits
+  EXACTLY once (first-committer-wins log shows one consuming tx).
+"""
+
+import os
+import sys
+import time
+import types
+
+import pytest
+
+from corda_tpu.crypto import sidecar as sc
+from corda_tpu.crypto.keys import KeyPair
+from corda_tpu.crypto.provider import CpuVerifier, VerifyJob
+from corda_tpu.flows.api import FlowLogic
+from corda_tpu.flows.notary import (
+    NotaryException,
+    OverloadedError,
+    notarise_with_retry,
+)
+from corda_tpu.node.messaging.tcp import TcpMessaging
+from corda_tpu.node.statemachine import StateMachineManager
+from corda_tpu.qos import context as qos
+from corda_tpu.qos.admission import MAX_RETRY_AFTER_S, AdmissionController
+from corda_tpu.testing import DummyContract
+from corda_tpu.testing.mock_network import MockNetwork
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_raft_group_commit import (  # noqa: E402
+    Net,
+    cmd,
+    elect,
+    make_trio,
+    settle,
+)
+
+
+@pytest.fixture()
+def plane():
+    p = qos.arm("test")
+    yield p
+    qos.disarm()
+
+
+def _fsm(ctx):
+    """Minimal FlowStateMachine stand-in for the scheduler unit tests."""
+    return types.SimpleNamespace(qos=ctx, qos_runnable_since=None,
+                                 trace_id=None, trace_span=None)
+
+
+# ---------------------------------------------------------------------------
+# QosContext codec + plane arming
+# ---------------------------------------------------------------------------
+
+
+def test_context_wire_roundtrip():
+    ctx = qos.QosContext(qos.LANE_BULK, deadline_ns=123456789,
+                         admitted_ns=987654321)
+    raw = ctx.to_wire()
+    assert len(raw) == qos.WIRE_SIZE == 17
+    assert qos.QosContext.from_wire(raw) == ctx
+
+
+def test_context_from_wire_rejects_junk_without_raising():
+    good = qos.QosContext().to_wire()
+    assert qos.QosContext.from_wire(good) is not None
+    assert qos.QosContext.from_wire(good[:-1]) is None       # short
+    assert qos.QosContext.from_wire(good + b"x") is None     # long
+    assert qos.QosContext.from_wire("not-bytes") is None     # wrong type
+    assert qos.QosContext.from_wire(b"\xff" + good[1:]) is None  # bad lane
+
+
+def test_new_context_derives_deadline_for_interactive_only(plane):
+    t0 = qos.now_ns()
+    ictx = plane.new_context(qos.LANE_INTERACTIVE, slo_ms=100.0)
+    assert ictx.deadline_ns >= t0 + int(99 * 1e6)
+    assert ictx.admitted_ns >= t0
+    bctx = plane.new_context(qos.LANE_BULK, slo_ms=100.0)
+    assert bctx.deadline_ns == 0  # bulk is the sheddable, deadline-free class
+
+
+def test_near_deadline_is_interactive_only_and_guarded(plane):
+    soon = qos.QosContext(qos.LANE_INTERACTIVE,
+                          deadline_ns=qos.now_ns() + 1_000_000)
+    far = qos.QosContext(qos.LANE_INTERACTIVE,
+                         deadline_ns=qos.now_ns() + 10 ** 12)
+    bulk = qos.QosContext(qos.LANE_BULK, deadline_ns=qos.now_ns())
+    assert plane.near_deadline(soon)        # inside the 5 ms default guard
+    assert not plane.near_deadline(far)
+    assert not plane.near_deadline(bulk)    # bulk never triggers a flush
+    assert not plane.near_deadline(None)
+    assert not plane.near_deadline(qos.QosContext())  # no deadline stamped
+
+
+def test_arm_from_env(monkeypatch):
+    try:
+        monkeypatch.delenv(qos.ENV_VAR, raising=False)
+        assert qos.arm_from_env("n") is None
+        monkeypatch.setenv(qos.ENV_VAR, "off")
+        assert qos.arm_from_env("n") is None
+        monkeypatch.setenv(qos.ENV_VAR, "on")
+        p = qos.arm_from_env("n")
+        assert p is not None and p.slo_ms == 50.0 and p.bulk_every == 4
+        monkeypatch.setenv(qos.ENV_VAR, "slo_ms=75,guard_ms=2,bulk_every=3")
+        p = qos.arm_from_env("n")
+        assert p.slo_ms == 75.0
+        assert p.deadline_guard_ns == 2_000_000
+        assert p.bulk_every == 3
+    finally:
+        qos.disarm()
+
+
+def test_link_map_is_bounded(plane):
+    for i in range(qos.LINK_MAP_MAX + 5):
+        plane.register_link(i.to_bytes(8, "big"), qos.QosContext())
+    # Wholesale clear at the cap: correlation loss beats unbounded growth.
+    assert len(plane._links) <= qos.LINK_MAP_MAX
+    assert plane.counters["links_dropped"] >= qos.LINK_MAP_MAX
+
+
+# ---------------------------------------------------------------------------
+# Admission controller
+# ---------------------------------------------------------------------------
+
+
+def test_admission_unlimited_rate_admits_everything():
+    adm = AdmissionController()
+    for _ in range(100):
+        assert adm.admit(qos.LANE_BULK) is None
+        assert adm.admit(qos.LANE_INTERACTIVE) is None
+    stats = adm.stats()
+    assert stats["shed_bulk"] == 0 and stats["shed_interactive"] == 0
+
+
+def test_admission_bulk_bucket_sheds_with_bounded_retry_after():
+    adm = AdmissionController(bulk_rate=0.5, bulk_burst=2.0)
+    assert adm.admit(qos.LANE_BULK) is None
+    assert adm.admit(qos.LANE_BULK) is None
+    retry = adm.admit(qos.LANE_BULK)  # burst spent; refill is 2 s/token
+    assert retry is not None and 0.0 < retry <= MAX_RETRY_AFTER_S
+    # The interactive bucket is independent: still unlimited here.
+    assert adm.admit(qos.LANE_INTERACTIVE) is None
+    stats = adm.stats()
+    assert stats["admitted_bulk"] == 2 and stats["shed_bulk"] == 1
+
+
+def test_admission_watermark_sheds_bulk_only():
+    adm = AdmissionController(queue_watermark=5)
+    assert adm.admit(qos.LANE_BULK, queue_depth=5) is None   # at, not over
+    retry = adm.admit(qos.LANE_BULK, queue_depth=6)
+    assert retry is not None and 0.0 < retry <= MAX_RETRY_AFTER_S
+    # Interactive rides over the watermark: depth pressure sheds only the
+    # deprioritised class.
+    assert adm.admit(qos.LANE_INTERACTIVE, queue_depth=1000) is None
+    assert adm.stats()["watermark_sheds"] == 1
+
+
+def test_admission_unknown_lane_uses_interactive_bucket():
+    adm = AdmissionController(interactive_rate=0.5, interactive_burst=1.0)
+    assert adm.admit("mystery") is None
+    assert adm.admit("mystery") is not None  # drained the interactive burst
+    assert adm.stats()["shed_interactive"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SMM lane scheduling (queueing point 1: the flow run queue)
+# ---------------------------------------------------------------------------
+
+
+def _drain(mgr):
+    order = []
+    while mgr._runnable:
+        order.append(StateMachineManager._next_runnable(mgr))
+    return order
+
+
+def test_disarmed_scheduler_is_strict_fifo():
+    assert qos.ACTIVE is None
+    fsms = [_fsm(None) for _ in range(5)]
+    mgr = types.SimpleNamespace(_runnable=list(fsms), _qos_pick_counter=0)
+    assert _drain(mgr) == fsms          # pop(0), the pre-QoS behaviour
+    assert mgr._qos_pick_counter == 0   # the counter never even moves
+
+
+def test_armed_scheduler_serves_interactive_first_with_antistarvation(plane):
+    i = [_fsm(plane.new_context(qos.LANE_INTERACTIVE)) for _ in range(4)]
+    b = [_fsm(plane.new_context(qos.LANE_BULK)) for _ in range(3)]
+    mgr = types.SimpleNamespace(
+        _runnable=[b[0], i[0], b[1], i[1], i[2], i[3], b[2]],
+        _qos_pick_counter=0)
+    # Every 4th pick (bulk_every=4) takes the oldest bulk step while both
+    # classes are runnable; once one class drains, FIFO within the other.
+    assert _drain(mgr) == [i[0], i[1], i[2], b[0], i[3], b[1], b[2]]
+    assert plane.counters["bulk_antistarvation_picks"] == 1
+
+
+def test_antistarvation_ratio_holds_under_sustained_mixed_load(plane):
+    inter = [_fsm(plane.new_context(qos.LANE_INTERACTIVE))
+             for _ in range(40)]
+    bulk = [_fsm(plane.new_context(qos.LANE_BULK)) for _ in range(40)]
+    mixed = [f for pair in zip(bulk, inter) for f in pair]
+    mgr = types.SimpleNamespace(_runnable=mixed, _qos_pick_counter=0)
+    order = _drain(mgr)
+    # While both classes are runnable the pattern is i,i,i,b repeating:
+    # 52 picks drain 39 interactive + 13 bulk, bulk exactly at every
+    # 4th slot — the 1-in-bulk_every anti-starvation contract.
+    head = order[:52]
+    bulk_positions = [k for k, f in enumerate(head)
+                      if f.qos.lane == qos.LANE_BULK]
+    assert bulk_positions == [3, 7, 11, 15, 19, 23, 27, 31, 35, 39, 43,
+                              47, 51]
+    assert plane.counters["bulk_antistarvation_picks"] == 13
+    # Unlabelled flows schedule WITH interactive (never starved by bulk).
+    mgr2 = types.SimpleNamespace(
+        _runnable=[_fsm(plane.new_context(qos.LANE_BULK)), _fsm(None)],
+        _qos_pick_counter=0)
+    assert StateMachineManager._next_runnable(mgr2).qos is None
+
+
+def test_unlabelled_only_queue_keeps_exact_fifo_when_armed(plane):
+    fsms = [_fsm(None) for _ in range(6)]
+    mgr = types.SimpleNamespace(_runnable=list(fsms), _qos_pick_counter=0)
+    assert _drain(mgr) == fsms
+    assert plane.counters["bulk_antistarvation_picks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Deadline pressure on the SMM verify micro-batch + the sidecar hint
+# ---------------------------------------------------------------------------
+
+
+def _verify_mgr():
+    return types.SimpleNamespace(
+        _verify_queue=[], _verify_waiting_since=0.0, _verify_sig_count=0,
+        _verify_qos_deadline_ns=0, verifier=types.SimpleNamespace())
+
+
+def _req(n_sigs=1):
+    return types.SimpleNamespace(
+        stx=types.SimpleNamespace(sigs=[object()] * n_sigs))
+
+
+def test_enqueue_verify_tracks_min_interactive_deadline(plane):
+    mgr = _verify_mgr()
+    now = qos.now_ns()
+    StateMachineManager._enqueue_verify(
+        mgr, _fsm(qos.QosContext(qos.LANE_INTERACTIVE, now + 500)), _req())
+    StateMachineManager._enqueue_verify(
+        mgr, _fsm(qos.QosContext(qos.LANE_INTERACTIVE, now + 300)), _req())
+    StateMachineManager._enqueue_verify(
+        mgr, _fsm(qos.QosContext(qos.LANE_BULK, now + 1)), _req())
+    StateMachineManager._enqueue_verify(mgr, _fsm(None), _req())
+    assert mgr._verify_qos_deadline_ns == now + 300  # bulk never lowers it
+    assert len(mgr._verify_queue) == 4
+
+
+def test_verify_deadline_pressure_flags_only_near_deadlines(plane):
+    mgr = _verify_mgr()
+    mgr._verify_queue = [object()]
+    mgr._verify_qos_deadline_ns = qos.now_ns() + 1_000_000  # inside guard
+    assert StateMachineManager.verify_deadline_pressure(mgr)
+    mgr._verify_qos_deadline_ns = qos.now_ns() + 10 ** 12
+    assert not StateMachineManager.verify_deadline_pressure(mgr)
+    mgr._verify_qos_deadline_ns = 0
+    assert not StateMachineManager.verify_deadline_pressure(mgr)
+    mgr._verify_queue = []  # empty batch: nothing to flush early
+    mgr._verify_qos_deadline_ns = qos.now_ns()
+    assert not StateMachineManager.verify_deadline_pressure(mgr)
+
+
+def test_verify_deadline_pressure_false_when_disarmed():
+    assert qos.ACTIVE is None
+    mgr = _verify_mgr()
+    mgr._verify_queue = [object()]
+    mgr._verify_qos_deadline_ns = 1
+    assert not StateMachineManager.verify_deadline_pressure(mgr)
+
+
+def test_qos_verify_hint_forwards_min_deadline_to_verifier(plane):
+    mgr = _verify_mgr()
+    mgr._verify_qos_deadline_ns = 123
+    StateMachineManager._qos_verify_hint(mgr)
+    assert mgr.verifier.qos_hint == (qos.LANE_INTERACTIVE, 123)
+    mgr._verify_qos_deadline_ns = 0
+    StateMachineManager._qos_verify_hint(mgr)
+    assert mgr.verifier.qos_hint is None
+
+
+def test_qos_queue_depth_counts_runnable_and_parked():
+    mgr = types.SimpleNamespace(_runnable=[1, 2], _service_queue=[3])
+    assert StateMachineManager.qos_queue_depth(mgr) == 3
+
+
+# ---------------------------------------------------------------------------
+# TCP wire frame: one extra field, only when armed + labelled
+# ---------------------------------------------------------------------------
+
+
+def test_wire_tuple_grows_one_field_only_when_armed():
+    from corda_tpu.node.messaging.api import TopicSession
+
+    fake = types.SimpleNamespace(
+        my_address=types.SimpleNamespace(host="h", port=1))
+    ts = TopicSession("t", 0)
+    assert qos.ACTIVE is None
+    base = TcpMessaging._wire_tuple(fake, ts, b"u", b"d")
+    assert len(base) == 7  # the disarmed frame never grows
+    try:
+        plane = qos.arm("wire")
+        assert len(TcpMessaging._wire_tuple(fake, ts, b"u", b"d")) == 7
+        qos.set_context(plane.new_context(qos.LANE_BULK))
+        armed = TcpMessaging._wire_tuple(fake, ts, b"u", b"d")
+        assert len(armed) == 8
+        decoded = qos.QosContext.from_wire(armed[7])
+        assert decoded is not None and decoded.lane == qos.LANE_BULK
+    finally:
+        qos.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Sidecar deadline scheduler (queueing point 2: cross-process batches)
+# ---------------------------------------------------------------------------
+
+
+def _sock_dir():
+    import shutil
+    import tempfile
+
+    # Short /tmp path on purpose: AF_UNIX paths cap at ~108 bytes.
+    d = tempfile.mkdtemp(prefix="qos-", dir="/tmp")
+    return d, shutil.rmtree
+
+
+def _good_job():
+    kp = KeyPair.generate(b"\x09" * 32)
+    msg = b"qos-deadline-flush".ljust(32, b".")
+    sig = kp.sign(msg)
+    return VerifyJob(bytes(sig.by.encoded), msg, bytes(sig.bytes))
+
+
+def _verify_qos_rtt(sock, req_id, lane, deadline_ns):
+    sc.send_frame(sock, sc.encode_verify_request_qos(
+        req_id, [_good_job()], lane, deadline_ns))
+    t0 = time.perf_counter()
+    payload = sc.recv_frame(sock)
+    elapsed = time.perf_counter() - t0
+    op, rid, status, _tier, _wait, _verify = \
+        sc._VERIFY_REPLY_HDR.unpack_from(payload)
+    body = payload[sc._VERIFY_REPLY_HDR.size:]
+    assert (op, rid, status) == (sc.OP_VERIFY, req_id, sc.STATUS_OK)
+    assert body == b"\x01"  # the valid signature verified
+    return elapsed
+
+
+def test_sidecar_deadline_flushes_before_coalesce_window_closes():
+    d, cleanup = _sock_dir()
+    srv = sc.SidecarServer(os.path.join(d, "s.sock"),
+                           verifier=CpuVerifier(), coalesce_us=600_000,
+                           qos_guard_us=2_000).start()
+    try:
+        sock = sc.connect(srv.address, timeout=10.0)
+        # A bulk request (no deadline) waits out the full 600 ms window.
+        slow = _verify_qos_rtt(sock, 1, sc.LANE_CODE_BULK, 0)
+        assert slow >= 0.45
+        assert srv.qos_early_flushes == 0
+        # An interactive deadline 50 ms out cuts the batch ~48 ms in:
+        # deadline-aware coalescing across the process boundary.
+        fast = _verify_qos_rtt(sock, 2, sc.LANE_CODE_INTERACTIVE,
+                               time.time_ns() + 50_000_000)
+        assert fast < 0.35
+        assert srv.qos_early_flushes >= 1
+        stats = srv.stats()
+        assert stats["qos_bulk_requests"] == 1
+        assert stats["qos_interactive_requests"] == 1
+        sock.close()
+    finally:
+        srv.stop()
+        cleanup(d, ignore_errors=True)
+
+
+def test_sidecar_form_batch_packs_interactive_first():
+    srv = sc.SidecarServer("/tmp/qos-unstarted.sock",
+                           verifier=CpuVerifier(), max_sigs=2)
+    jobs = lambda: [_good_job()]  # noqa: E731
+    b1 = sc._Pending(None, 1, jobs(), lane=sc.LANE_CODE_BULK)
+    i1 = sc._Pending(None, 2, jobs(), lane=sc.LANE_CODE_INTERACTIVE)
+    b2 = sc._Pending(None, 3, jobs(), lane=sc.LANE_CODE_BULK)
+    i2 = sc._Pending(None, 4, jobs(), lane=sc.LANE_CODE_INTERACTIVE)
+    srv._pending.extend([b1, i1, b2, i2])
+    batch, reordered = srv._form_batch()
+    # max_sigs=2: the batch is cut from the latency-sensitive end (FIFO
+    # within the class) and the deferred bulk keeps its arrival order.
+    assert batch == [i1, i2] and reordered
+    assert list(srv._pending) == [b1, b2]
+    batch, reordered = srv._form_batch()
+    assert batch == [b1, b2] and not reordered
+
+
+def test_sidecar_form_batch_without_bulk_is_plain_fifo():
+    srv = sc.SidecarServer("/tmp/qos-unstarted2.sock",
+                           verifier=CpuVerifier(), max_sigs=4096)
+    plain = sc._Pending(None, 1, [_good_job()])  # pre-QoS OP_VERIFY
+    inter = sc._Pending(None, 2, [_good_job()],
+                        lane=sc.LANE_CODE_INTERACTIVE)
+    srv._pending.extend([plain, inter])
+    batch, reordered = srv._form_batch()
+    assert batch == [plain, inter] and not reordered  # bit-identical order
+
+
+# ---------------------------------------------------------------------------
+# Raft group-commit early seal (queueing point 3: the leader's batch)
+# ---------------------------------------------------------------------------
+
+
+def test_raft_leader_seals_batch_early_for_near_deadline(tmp_path, plane):
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])
+    leader = members["A"]
+    elect(net, leader, t)
+
+    far = cmd(b"r1", b"t1", b"rid-far")
+    plane.register_link(far.request_id, qos.QosContext(
+        qos.LANE_INTERACTIVE, deadline_ns=qos.now_ns() + 10 ** 12))
+    leader.submit(far)
+    # A comfortable deadline keeps the round coalescing as usual.
+    assert leader.metrics["qos_early_seals"] == 0
+    assert len(leader._pending_batch) == 1
+
+    near = cmd(b"r2", b"t2", b"rid-near")
+    plane.register_link(near.request_id, qos.QosContext(
+        qos.LANE_INTERACTIVE, deadline_ns=qos.now_ns() + 1_000_000))
+    leader.submit(near)
+    # Inside the guard window: the buffer seals NOW instead of waiting
+    # for the scheduling round to close.
+    assert leader.metrics["qos_early_seals"] == 1
+    assert not leader._pending_batch
+
+    settle(net, list(members.values()))
+    assert leader.decided[far.request_id].ok
+    assert leader.decided[near.request_id].ok  # early seal still commits
+
+
+def test_raft_bulk_and_unlinked_commands_never_force_a_seal(tmp_path, plane):
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])
+    leader = members["A"]
+    elect(net, leader, t)
+
+    bulk = cmd(b"r3", b"t3", b"rid-bulk")
+    plane.register_link(bulk.request_id, qos.QosContext(
+        qos.LANE_BULK, deadline_ns=qos.now_ns()))
+    leader.submit(bulk)
+    leader.submit(cmd(b"r4", b"t4", b"rid-unlinked"))
+    assert leader.metrics["qos_early_seals"] == 0
+    assert len(leader._pending_batch) == 2  # both ride the normal round
+
+    settle(net, list(members.values()))
+    assert leader.metrics["group_commits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Overload shed + retry (admission at the notarise entry point)
+# ---------------------------------------------------------------------------
+
+
+class _RetryingClient(FlowLogic):
+    """notarise_with_retry wrapper: the production shed-recovery path."""
+
+    def __init__(self, stx):
+        self.stx = stx
+
+    def call(self):
+        sig = yield from notarise_with_retry(self, self.stx, retries=4)
+        return sig
+
+
+def _move_stx(net, notary, alice, bob):
+    builder = DummyContract.generate_initial(
+        alice.identity.ref(b"\x00"), 7, notary.identity)
+    builder.sign_with(alice.key)
+    issue_stx = builder.to_signed_transaction()
+    alice.record_transaction(issue_stx)
+    move = DummyContract.move(issue_stx.tx.out_ref(0),
+                              bob.identity.owning_key)
+    move.sign_with(alice.key)
+    return move.to_signed_transaction(check_sufficient_signatures=False)
+
+
+def test_bulk_shed_then_retry_commits_exactly_once(plane):
+    net = MockNetwork(verifier=CpuVerifier())
+    try:
+        notary = net.create_notary_node("Notary")
+        alice = net.create_node("Alice")
+        bob = net.create_node("Bob")
+        admission = AdmissionController(bulk_rate=2.0, bulk_burst=1.0)
+        notary.notary_service.admission = admission
+        stx = _move_stx(net, notary, alice, bob)
+
+        # Drain the single bulk token so the flow's first attempt is shed
+        # (the overload chaos), then let the bucket refill (~0.5 s) while
+        # notarise_with_retry parks on the server's retry-after floor.
+        assert admission.admit(qos.LANE_BULK) is None
+        handle = alice.smm.add(_RetryingClient(stx),
+                               qos=plane.new_context(qos.LANE_BULK))
+        net.run_network()
+
+        assert handle.result.done and handle.result.exception() is None
+        stats = admission.stats()
+        # The bulk lane label PROPAGATED: the notary judged this flow in
+        # the bulk bucket (shed), not the unlabelled/interactive default.
+        assert stats["shed_bulk"] >= 1
+        assert stats["admitted_bulk"] == 2  # the pre-drain + the retry
+        # Exactly once: first-committer-wins log holds ONE consuming tx
+        # for the input, and it is this tx — the shed attempt committed
+        # nothing and the retry did not double-commit.
+        committed = notary.uniqueness_provider._committed
+        consumed = stx.tx.inputs[0]
+        assert committed[consumed].id == stx.id
+        assert sum(1 for c in committed.values() if c.id == stx.id) == 1
+    finally:
+        net.stop_nodes()
+
+
+def test_shed_reply_carries_retryable_overload_error(plane):
+    net = MockNetwork(verifier=CpuVerifier())
+    try:
+        notary = net.create_notary_node("Notary")
+        alice = net.create_node("Alice")
+        bob = net.create_node("Bob")
+        # Zero-burst-equivalent: one token, drained; no refill to speak of
+        # (0.01/s) so EVERY bulk attempt inside the test window is shed.
+        admission = AdmissionController(bulk_rate=0.01, bulk_burst=1.0)
+        notary.notary_service.admission = admission
+        assert admission.admit(qos.LANE_BULK) is None
+        stx = _move_stx(net, notary, alice, bob)
+
+        from corda_tpu.flows.notary import NotaryClientFlow
+
+        # A RAW client (no retry wrapper) surfaces the shed to its caller.
+        handle = alice.smm.add(NotaryClientFlow(stx),
+                               qos=plane.new_context(qos.LANE_BULK))
+        net.run_network()
+        exc = handle.result.exception()
+        assert isinstance(exc, NotaryException)
+        assert isinstance(exc.error, OverloadedError)
+        assert exc.error.lane == qos.LANE_BULK
+        assert 0.0 < exc.error.retry_after_ms <= MAX_RETRY_AFTER_S * 1e3
+        # Nothing was decided about the tx: the input is unconsumed.
+        assert stx.tx.inputs[0] not in notary.uniqueness_provider._committed
+    finally:
+        net.stop_nodes()
+
+
+# ---------------------------------------------------------------------------
+# Stage registry (satellite: obs integration)
+# ---------------------------------------------------------------------------
+
+
+def test_qos_stages_registered_in_obs():
+    from corda_tpu.obs import stages
+
+    assert "admission_wait" in stages.DIRECT_STAGES
+    assert "lane_queue_wait" in stages.DIRECT_STAGES
+    assert "qos_flush" in stages.MARKER_SPANS

@@ -53,9 +53,12 @@ class TestTreeGate:
         assert len(report.suppressed) >= 15
 
     def test_checked_in_baseline_entries_are_live_files_with_reasons(self):
-        entries = load_baseline(REPO / "corda_tpu/analysis/baseline.json")
-        assert entries, "baseline file missing or empty"
-        for e in entries:
+        # The baseline shrinks monotonically (round 12 resolved the last
+        # two entries at source, so empty is the healthy end state); any
+        # entry that IS carried must name a live file and a reason.
+        path = REPO / "corda_tpu/analysis/baseline.json"
+        assert path.exists(), "baseline file missing"
+        for e in load_baseline(path):
             assert (REPO / e["path"]).exists(), e["path"]
             assert str(e.get("reason", "")).strip(), e
 
