@@ -556,7 +556,7 @@ def bench_partial_merkle(n_cmds=8, repeats=2000):
 
 def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
                        notary_device="cpu", notary="raft", sidecar=False,
-                       sidecar_devices=0):
+                       sidecar_devices=0, adaptive_coalesce=False):
     """BASELINE config 1 (raft-notary-demo) at BASELINE size: a real 3-node
     Raft notary cluster, every node its OWN OS process (own GIL, TCP
     sockets, sqlite), firehosed by two client processes running the
@@ -595,7 +595,7 @@ def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
         n_tx=n_tx, width=width, clients=2, notary=notary,
         verifier=verifier, client_verifier="cpu",
         notary_device=notary_device, max_seconds=420.0, sidecar=sidecar,
-        sidecar_devices=sidecar_devices)
+        sidecar_devices=sidecar_devices, adaptive_coalesce=adaptive_coalesce)
     dev_b = sum((s or {}).get("device_batches") or 0
                 for s in res.node_stamps.values())
     host_b = sum((s or {}).get("host_batches") or 0
@@ -615,7 +615,44 @@ def bench_raft_cluster(n_tx=1000, width=32, verifier="cpu",
                                  if (dev_b + host_b) else 0.0),
             "sidecar": res.sidecar,
             "sidecar_devices": sidecar_devices or None,
+            "adaptive_coalesce": adaptive_coalesce,
             "node_stamps": res.node_stamps}
+
+
+def bench_validating_flagship(**kw):
+    """The raft_validating_3node flagship, run as a STATIC/ADAPTIVE
+    coalesce-window A/B (ROADMAP item 1 leftover: the adaptive controller
+    shipped in PR 7 off by default — this arms it in the flagship path and
+    stamps the verdict instead of leaving the flag dead). The returned
+    dict IS the armed (adaptive) run, so the flagship keys keep their
+    grep-able shape; the static counterpart and the verdict ride under
+    "adaptive_coalesce_ab"."""
+    kw.setdefault("n_tx", 400)
+    kw.setdefault("notary", "raft-validating")
+    kw.setdefault("sidecar", True)
+    before = bench_raft_cluster(adaptive_coalesce=False, **kw)
+    after = bench_raft_cluster(adaptive_coalesce=True, **kw)
+
+    def _hoist(run):
+        return {k: run.get(k) for k in (
+            "tx_per_sec", "p50_ms", "p99_ms", "loadtest_sigs_per_sec")}
+
+    b_tx, a_tx = before.get("tx_per_sec") or 0.0, after.get("tx_per_sec") or 0.0
+    b_p99, a_p99 = before.get("p99_ms") or 0.0, after.get("p99_ms") or 0.0
+    after["adaptive_coalesce_ab"] = {
+        "static": _hoist(before),
+        "adaptive": _hoist(after),
+        "static_sidecar": before.get("sidecar"),
+        "tx_per_sec_ratio": round(a_tx / b_tx, 3) if b_tx else None,
+        "p99_ratio": round(a_p99 / b_p99, 3) if b_p99 else None,
+        # The arming bar: adaptive must not cost meaningful throughput
+        # (>= 95% of static) nor blow the tail (<= 120% of static p99) —
+        # the controller's job is to EARN its shorter windows under gaps.
+        "adaptive_no_worse": bool(
+            b_tx and a_tx >= 0.95 * b_tx
+            and (not b_p99 or a_p99 <= 1.2 * b_p99)),
+    }
+    return after
 
 
 def bench_resolve_ids(n_tx=2048, outputs_per_tx=8, host_only=False):
@@ -857,6 +894,19 @@ def bench_slo_sweep(rates=(60.0, 120.0, 240.0), n_tx=240, width=4,
                                     if a_int.p99_ms else None),
         "slo_met": bool(within and shed),
     }
+    # Measured-saturation admission: derive the per-lane rates the static
+    # TOML used to guess from THIS armed sweep (qos/calibrate.py). Stamped
+    # beside the sweep so the knobs always travel with the observations
+    # that produced them; apply_calibration pushes them into a live
+    # controller.
+    try:
+        from corda_tpu.qos import calibrate_admission
+
+        out["calibration"] = calibrate_admission(
+            {rate: by_lane for rate, by_lane in armed.items()},
+            slo_ms=slo_ms)
+    except Exception as e:
+        out["calibration"] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
 
@@ -1102,6 +1152,47 @@ def bench_chaos(n_tx=60, cluster_size=3, rate_tx_s=120.0):
     out["leader_kill_recovery_s"] = kill.leader_kill_recovery_s
     out["faults_injected"] = lossy.faults_injected
     out["lossy_open_loop_p99_ms"] = lossy.p99_ms
+    return out
+
+
+def bench_reshard(n_tx=200, rate_tx_s=80.0, shards=2, to_shards=4,
+                  cross_frac=0.2):
+    """Elastic resharding section (round 13): the group count DOUBLES
+    mid-sweep — a live split under open-loop load with the builtin
+    "reshard" chaos plan armed (lossy transport + dropped handoff frames
+    + stale netmap refreshes) — and then halves back in a clean merge run.
+    The claim under test is a p99 blip, not an outage: the split must
+    complete with exactly_once=true (every tx committed exactly once,
+    ledger rows across the NEW groups totalling exactly the consumed
+    refs, zero leaked reservations), client retries bounded (the
+    wrong_epoch bounce count), and the latency windows split at the
+    plan-publish / cutover marks showing where the tail went.
+
+    Headline keys hoisted flat for the bench contract: exactly_once,
+    wrong_epoch_bounces, reshard_window_s, p99_before/during/after_ms."""
+    from corda_tpu.tools.loadtest import run_reshard_loadtest
+
+    out = {"harness": "inproc-reshard", "n_tx": n_tx,
+           "rate_tx_s": rate_tx_s, "plan": "reshard"}
+    split = run_reshard_loadtest(
+        plan="reshard", n_tx=n_tx, shards=shards, to_shards=to_shards,
+        rate_tx_s=rate_tx_s, cross_frac=cross_frac)
+    out["split"] = dict(split.__dict__)
+    merge = run_reshard_loadtest(
+        plan=None, n_tx=max(40, n_tx // 2), shards=to_shards,
+        to_shards=shards, rate_tx_s=rate_tx_s)
+    out["merge"] = dict(merge.__dict__)
+    out["exactly_once"] = bool(split.exactly_once and merge.exactly_once)
+    out["wrong_epoch_bounces"] = split.wrong_epoch_bounces
+    out["handoff_frames"] = split.handoff_frames
+    out["faults_injected"] = split.faults_injected
+    out["reshard_window_s"] = (
+        round(split.reshard_completed_s - split.reshard_started_s, 3)
+        if (split.reshard_completed_s is not None
+            and split.reshard_started_s is not None) else None)
+    out["p99_before_ms"] = split.p99_before_ms
+    out["p99_during_ms"] = split.p99_during_ms
+    out["p99_after_ms"] = split.p99_after_ms
     return out
 
 
@@ -1367,9 +1458,11 @@ def _run_host_only_phases(report: dict,
             # The validating flagship is sidecar-fed even host-only:
             # measured at parity without a device (41.0 vs 40.3 tx/s,
             # p99 3.52 vs 3.55 s), and it keeps the host-only report on
-            # the same code path the device flagship measures.
-            ("raft_validating_3node", lambda: bench_raft_cluster(
-                n_tx=400, notary="raft-validating", sidecar=True)),
+            # the same code path the device flagship measures. Round 13
+            # arms the adaptive coalesce window — the flagship result IS
+            # the armed run, with the static A/B under
+            # adaptive_coalesce_ab.
+            ("raft_validating_3node", bench_validating_flagship),
             ("open_loop_latency", bench_open_loop_latency),
             ("raft_open_loop_latency", lambda: bench_raft_open_loop(
                 sidecar=True)),
@@ -1378,6 +1471,9 @@ def _run_host_only_phases(report: dict,
             # identical section the device path does.
             ("slo_sweep", bench_slo_sweep),
             ("shard_scaling", bench_shard_scaling),
+            # Group count doubles mid-sweep under the lossy reshard plan;
+            # the contract is exactly_once + a bounded p99 blip.
+            ("reshard", bench_reshard),
             # Virtual host mesh: parity + pad/occupancy contract without
             # real chips (sigs/s not expected to scale — see docstring).
             ("multichip_scaling", lambda: bench_multichip_scaling(
@@ -1572,10 +1668,11 @@ def _run_phases(report: dict) -> None:
     # device-owning server all members feed, coalescing micro-batches
     # across processes (the r05 device_batches=0 fix — crypto/sidecar.py).
     for name, fn in (("raft_notary_3node", bench_raft_cluster),
-                     ("raft_validating_3node", lambda: bench_raft_cluster(
-                         n_tx=400, notary="raft-validating",
-                         verifier="jax", notary_device="accelerator",
-                         sidecar=True)),
+                     # Armed adaptive-coalesce flagship (static A/B rides
+                     # under adaptive_coalesce_ab — round 13).
+                     ("raft_validating_3node",
+                      lambda: bench_validating_flagship(
+                          verifier="jax", notary_device="accelerator")),
                      ("open_loop_latency", bench_open_loop_latency),
                      ("raft_open_loop_latency", lambda: bench_raft_open_loop(
                          verifier="jax", notary_device="accelerator",
@@ -1586,6 +1683,9 @@ def _run_phases(report: dict) -> None:
                      # claim is about scheduling, not kernels).
                      ("slo_sweep", lambda: bench_slo_sweep(sidecar=True)),
                      ("shard_scaling", bench_shard_scaling),
+                     # Group count doubles mid-sweep under the lossy
+                     # reshard plan; exactly_once + a bounded p99 blip.
+                     ("reshard", bench_reshard),
                      ("multichip_scaling", lambda: bench_multichip_scaling(
                          notary_device="accelerator", flagship=True)),
                      ("resolve_ids", bench_resolve_ids),

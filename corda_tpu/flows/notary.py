@@ -126,6 +126,24 @@ class OverloadedError(NotaryError):
 
 @register
 @dataclass(frozen=True)
+class WrongShardEpoch(NotaryError):
+    """The shard group that received the request does not own (some of) the
+    touched states under the shard-map epoch it currently enforces — a
+    reshard landed between the client deriving its directory and the
+    commit applying. RETRYABLE like NotaryUnavailable, with one extra
+    obligation: re-sending to the SAME member can never succeed, so
+    notarise_with_retry re-derives the shard directory from the network
+    map before its next attempt."""
+
+    reason: str = ""
+
+    def __str__(self):
+        return (f"Notary shard map changed underneath the request "
+                f"(re-derive the directory): {self.reason}")
+
+
+@register
+@dataclass(frozen=True)
 class NotarySignaturesMissing(NotaryError):
     missing: frozenset
 
@@ -240,23 +258,43 @@ def _resolve_member(flow: FlowLogic, legal_name: str) -> Party | None:
 
 def _shard_directory(flow: FlowLogic):
     """Discover the sharded-notary topology from the network map: members
-    of shard group g advertise "corda.notary.shard.<g>of<n>", so the map
-    every party already syncs doubles as the shard directory. Returns
-    (count, {group: [Party, ...]}) or None when the notary is unsharded."""
-    from ..node.services.sharding import parse_shard_service
+    of shard group g advertise "corda.notary.shard.<g>of<n>[@epoch]", so
+    the map every party already syncs doubles as the shard directory.
+    Returns (count, {group: [Party, ...]}) or None when unsharded.
 
-    count = 0
-    groups: dict[int, list[Party]] = {}
+    Epoch-aware: mid-reshard the map mixes advertisements from two epochs
+    (members re-register as their fences activate). Prefer the highest
+    COMPLETE epoch — one with all of its `count` groups present — so the
+    client only adopts a new map once it can actually route everywhere;
+    when no epoch is complete (a refresh raced the re-registrations), fall
+    back to the epoch with the greatest group coverage, ties to the newer.
+    A wrong pick is never a correctness problem: the group's fence bounces
+    WrongShardEpoch and the retry re-derives."""
+    from ..node.services.sharding import parse_shard_service_full
+
+    # epoch -> (count, {group: [Party, ...]})
+    epochs: dict[int, tuple[int, dict[int, list[Party]]]] = {}
     try:
         for info in flow.service_hub.network_map_cache.party_nodes:
             for svc in info.advertised_services:
-                parsed = parse_shard_service(str(svc.type))
+                parsed = parse_shard_service_full(str(svc.type))
                 if parsed is not None:
-                    g, n = parsed
-                    count = max(count, n)
+                    g, n, e = parsed
+                    count, groups = epochs.setdefault(e, (n, {}))
+                    if n > count:
+                        epochs[e] = (n, groups)
                     groups.setdefault(g, []).append(info.legal_identity)
     except Exception:
         return None
+    best = None
+    for e, (count, groups) in epochs.items():
+        complete = len(groups) >= count
+        key = (1 if complete else 0, len(groups) if not complete else 0, e)
+        if best is None or key > best[0]:
+            best = (key, count, groups)
+    if best is None:
+        return None
+    _, count, groups = best
     if count <= 1 or not groups:
         return None
     for members in groups.values():
@@ -328,10 +366,15 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
     deadline = None if deadline_s is None else _time.monotonic() + deadline_s
     attempt = 0
     backoff = backoff_s
-    directory = _shard_directory(flow)
-    group = _route_group(stx, directory)
-    group_members = (frozenset(p.name for p in directory[1].get(group, ()))
-                     if directory is not None and group is not None else None)
+
+    def derive():
+        directory = _shard_directory(flow)
+        group = _route_group(stx, directory)
+        members = (frozenset(p.name for p in directory[1].get(group, ()))
+                   if directory is not None and group is not None else None)
+        return directory, group, members
+
+    directory, group, group_members = derive()
     # group id -> preferred member; None key = the unsharded single cluster.
     hints: dict = {}
     while True:
@@ -349,7 +392,11 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
             # OverloadedError is the admission-control shed: retryable for
             # the same reason NotaryUnavailable is — nothing was decided
             # about the transaction, the service just declined the work.
-            if not isinstance(e.error, (NotaryUnavailable, OverloadedError)):
+            # WrongShardEpoch is retryable too (a fence bounce decides
+            # nothing), but ONLY after re-deriving the shard directory:
+            # the member that bounced will bounce forever.
+            if not isinstance(e.error, (NotaryUnavailable, OverloadedError,
+                                        WrongShardEpoch)):
                 raise
             attempt += 1
             now = _time.monotonic()
@@ -357,6 +404,14 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
                     (deadline is not None and now >= deadline):
                 raise
             shed = isinstance(e.error, OverloadedError)
+            epoch_bump = isinstance(e.error, WrongShardEpoch)
+            if epoch_bump:
+                # The map moved underneath us: rebuild directory, routing
+                # group and the hint filter from the refreshed network map,
+                # and drop the stale group's preferred-member hint (it
+                # belongs to the old topology).
+                hints.pop(group, None)
+                directory, group, group_members = derive()
             if shed and e.error.retry_after_ms > 0:
                 # The server's refill estimate floors the park: retrying
                 # sooner would just be shed again at the same bucket.
@@ -376,17 +431,24 @@ def notarise_with_retry(flow: FlowLogic, stx: SignedTransaction,
                 if deadline is not None:
                     wake_at = min(wake_at, deadline)
                 pctx = (_obs.get_context()
-                        if shed and _obs.ACTIVE is not None else None)
+                        if (shed or epoch_bump) and _obs.ACTIVE is not None
+                        else None)
                 t_park = _obs.now() if pctx is not None else 0.0
                 yield flow.service_request(
                     lambda wake_at=wake_at: _timer_poll(wake_at))
                 if pctx is not None and _obs.ACTIVE is not None:
-                    # Client-side cost of the shed: the backoff park shows
-                    # up in the stage breakdown as admission_wait.
-                    _obs.record("admission_wait", t_park, _obs.now(),
-                                trace_id=pctx[0], parent=pctx[1],
-                                attrs={"lane": e.error.lane,
-                                       "attempt": attempt})
+                    # Client-side cost of the shed (admission_wait) or of a
+                    # reshard racing this request (epoch_wait): the backoff
+                    # park shows up in the stage breakdown either way.
+                    if epoch_bump:
+                        _obs.record("epoch_wait", t_park, _obs.now(),
+                                    trace_id=pctx[0], parent=pctx[1],
+                                    attrs={"attempt": attempt})
+                    else:
+                        _obs.record("admission_wait", t_park, _obs.now(),
+                                    trace_id=pctx[0], parent=pctx[1],
+                                    attrs={"lane": e.error.lane,
+                                           "attempt": attempt})
                 backoff = min(backoff * 2, max_backoff_s)
 
 
@@ -499,6 +561,7 @@ class NotaryServiceFlow(FlowLogic):
             UniquenessException,
             UniquenessUnavailableException,
         )
+        from ..node.services.raft import WrongShardEpochException
         from ..serialization.codec import serialize
 
         provider = self.service.uniqueness_provider
@@ -513,6 +576,12 @@ class NotaryServiceFlow(FlowLogic):
             conflict_data = serialize(e.error)
             signed = SignedData(conflict_data, self.service.sign(conflict_data.bytes))
             raise NotaryException(NotaryConflict(wtx.id, signed)) from e
+        except WrongShardEpochException as e:
+            # Must precede the generic unavailability mapping (it is a
+            # subclass): a fence bounce is retryable but the client has to
+            # re-derive the shard directory first — a leader hint for the
+            # OLD routing would aim the retry at the same fence.
+            raise NotaryException(WrongShardEpoch(str(e))) from e
         except UniquenessUnavailableException as e:
             # A consensus window elapsing says NOTHING about the tx: reply
             # with the RETRYABLE unavailability error, never "transaction

@@ -193,9 +193,14 @@ class NodeConfig:
         if shards_raw is not None:
             groups = tuple(tuple(g) for g in shards_raw.get("groups", ()))
             count = int(shards_raw.get("count", len(groups) or 1))
-            if groups and len(groups) != count:
+            # The groups list may be LONGER than count: groups beyond count
+            # are pending split targets, booted ahead of a live reshard
+            # (they own no keys until an epoch activates them). Shorter is
+            # still a misconfiguration — some keyspace would have no group.
+            if groups and len(groups) < count:
                 raise ValueError(
-                    f"notary_shards: count={count} but {len(groups)} groups")
+                    f"notary_shards: count={count} but "
+                    f"{len(groups)} groups")
             if not notary.startswith("raft"):
                 raise ValueError("notary_shards requires a raft-* notary")
             shards = ShardConfig(

@@ -28,6 +28,7 @@ from collections import deque
 from ..flows.data_vending import install_data_vending
 from ..obs import trace as _obs
 from ..qos import context as _qos
+from ..testing import faults as _faults
 from ..utils.clock import Clock
 from .config import NetMapEntry, NodeConfig, netmap_load, netmap_register
 from .messaging.tcp import TcpMessaging
@@ -125,19 +126,38 @@ class Node:
             services = (ServiceInfo(SIMPLE_NOTARY),)
         elif config.notary in ("validating", "raft-validating"):
             services = (ServiceInfo(VALIDATING_NOTARY),)
+        self._shard_epoch_advertised = 0
         if config.notary_shards is not None:
             # Shard members also advertise their group + the total shard
-            # count ("corda.notary.shard.<g>of<n>"): the netmap every party
-            # already syncs doubles as the shard directory, so clients
-            # recover the full shard map with zero extra round trips.
+            # count ("corda.notary.shard.<g>of<n>[@epoch]"): the netmap
+            # every party already syncs doubles as the shard directory, so
+            # clients recover the full shard map with zero extra round
+            # trips. Members of PENDING groups (index >= count — boot-ahead
+            # split targets) advertise nothing until a reshard epoch
+            # activates them, and a restart replays the group's durable
+            # fence so the advertisement matches what the state machine
+            # enforces (a retired member drops its shard string entirely).
+            import json as _json
+
             from .services.sharding import shard_service_string
 
             my_group = next(
                 (g for g, members in enumerate(config.notary_shards.groups)
                  if config.name in members), None)
-            if my_group is not None:
+            count, epoch = config.notary_shards.count, 0
+            raw = self.db.get_setting("shard_fence")
+            fence = _json.loads(raw) if raw else None
+            if fence is not None and fence.get("mode") == "retired":
+                my_group = None
+                self._shard_epoch_advertised = int(fence["epoch"])
+            elif fence is not None and fence.get("mode") == "active":
+                my_group = int(fence["group"])
+                count = int(fence["count"])
+                epoch = int(fence["epoch"])
+            if my_group is not None and my_group < count:
                 services += (ServiceInfo(ServiceType(shard_service_string(
-                    my_group, config.notary_shards.count))),)
+                    my_group, count, epoch))),)
+                self._shard_epoch_advertised = epoch
         self.info = NodeInfo(
             address=self.messaging.my_address,
             legal_identity=self.identity,
@@ -343,6 +363,10 @@ class Node:
 
         self.webserver = None
         self._started = False
+        # Elastic resharding: the latest plan seen on the netmap (set by
+        # refresh_netmap), and a throttle on the fence-observation poll.
+        self._reshard_plan: tuple[int, int, int] | None = None
+        self._fence_checked_at = 0.0
 
     # -- network map -------------------------------------------------------
 
@@ -362,6 +386,17 @@ class Node:
         path = self.config.network_map
         if path is None:
             return
+        if _faults.ACTIVE is not None:
+            # Stale-directory injection: drop skips this refresh round (the
+            # node keeps routing on its old map until the next cadence),
+            # stall delays it, crash kills the process inside fire().
+            act = _faults.ACTIVE.fire("netmap.refresh")
+            if act is not None:
+                action, delay_s = act
+                if action == "drop":
+                    return
+                if delay_s > 0:
+                    time.sleep(delay_s)
         entries = netmap_load(path)
         # Self-heal: if our own row vanished (a concurrent boot clobbered
         # the file before registration was flock-serialised, or an operator
@@ -373,7 +408,19 @@ class Node:
                 self.messaging.my_address.port, self.identity.owning_key,
                 tuple(str(s.type) for s in self.info.advertised_services))
             entries = netmap_load(path)
+        plan = None
         for entry in entries:
+            if entry.name.startswith("_"):
+                # Control pseudo-entry (no node behind it, no parseable
+                # key): the reshard plan rides the map as a service string.
+                from .services.sharding import parse_reshard_plan
+
+                for svc in entry.services:
+                    parsed = parse_reshard_plan(svc)
+                    if parsed is not None and (plan is None
+                                               or parsed[0] > plan[0]):
+                        plan = parsed
+                continue
             info = entry.node_info()
             self.identity_service.register_identity(info.legal_identity)
             self.network_map_cache.add_node(info)
@@ -382,6 +429,7 @@ class Node:
                     and entry.name in self.config.raft_cluster
                     and entry.name != self.config.name):
                 self.raft_member.peers[entry.name] = info.address
+        self._reshard_plan = plan
 
     def _raft_pump(self) -> None:
         """Drive consensus while a flow blocks in commit(): deliver raft
@@ -601,6 +649,7 @@ class Node:
         if flush is not None:
             flush()
         self._sample_metrics_maybe()
+        self._reshard_tick()
         return n
 
     # Counters HISTORY (the time-series half of the reference's JMX/Jolokia
@@ -627,6 +676,72 @@ class Node:
         while True:
             self.run_once(timeout=0.05)
             self.refresh_netmap_maybe()
+
+    # -- elastic resharding ------------------------------------------------
+
+    RESHARD_FENCE_POLL_S = 0.2
+
+    def _reshard_tick(self) -> None:
+        """Advance the elastic-reshard machinery, once per run-loop round.
+        Two halves, both no-ops outside a transition: (a) observe the local
+        group's APPLIED fence (every RESHARD_FENCE_POLL_S — the fence only
+        moves while a plan is live) and re-advertise the epoch'd service
+        string once it activates, so clients re-deriving the directory see
+        the new map; (b) drive the provider's handoff coordinator (active
+        only on the source group's current leader)."""
+        prov = self.uniqueness_provider
+        if prov is None or not hasattr(prov, "reshard_tick"):
+            return
+        now = time.monotonic()
+        if (self._reshard_plan is not None
+                and now - self._fence_checked_at >= self.RESHARD_FENCE_POLL_S):
+            self._fence_checked_at = now
+            self._observe_fence()
+        prov.reshard_tick(self._reshard_plan, now)
+
+    def _observe_fence(self) -> None:
+        """Align the advertisement + routing with the group's applied fence
+        state. Every member does this from its OWN replicated state (not
+        from the plan): a follower that applied the activation re-registers
+        even if the coordinator died right after committing it."""
+        import json as _json
+
+        raw = self.db.get_setting("shard_fence")
+        if not raw:
+            return
+        fence = _json.loads(raw)
+        mode = fence.get("mode")
+        if mode not in ("active", "retired"):
+            return  # sealed/importing: keep the old advertisement
+        epoch = int(fence["epoch"])
+        if epoch <= self._shard_epoch_advertised:
+            return
+        from .services.sharding import (
+            SHARD_SERVICE_PREFIX,
+            shard_service_string,
+        )
+
+        base = tuple(s for s in self.info.advertised_services
+                     if not str(s.type).startswith(SHARD_SERVICE_PREFIX))
+        if mode == "active":
+            base += (ServiceInfo(ServiceType(shard_service_string(
+                int(fence["group"]), int(fence["count"]), epoch))),)
+        # mode == "retired": the shard string is dropped — the member keeps
+        # serving its raft group (so lagging replicas can catch up and
+        # in-flight replies drain) but no client routes new work at it.
+        self.info = NodeInfo(
+            address=self.info.address,
+            legal_identity=self.info.legal_identity,
+            advertised_services=base,
+        )
+        path = self.config.network_map
+        if path is not None:
+            netmap_register(
+                path, self.config.name, self.messaging.my_address.host,
+                self.messaging.my_address.port, self.identity.owning_key,
+                tuple(str(s.type) for s in self.info.advertised_services))
+        self._shard_epoch_advertised = epoch
+        self.uniqueness_provider.reconfigure(int(fence["count"]), epoch)
 
     _netmap_refreshed_at = 0.0
 

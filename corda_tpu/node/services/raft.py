@@ -36,6 +36,7 @@ deterministically fast.
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import time as _time
@@ -150,6 +151,59 @@ class AbortReservedCommand:
 
 @register
 @dataclass(frozen=True)
+class ShardFenceCommand:
+    """Elastic-reshard fence (services/sharding.py): a replicated marker
+    that moves THIS group's shard-ownership state machine through the
+    split/merge handoff. ``mode="seal"`` freezes the moving keyspace — from
+    this log position on, any command touching a ref that epoch `epoch`
+    assigns elsewhere bounces with the retryable WRONG_EPOCH outcome, while
+    refs the group keeps commit normally (no outage for the unmoved
+    keyspace). The seal's log position IS the linearization point of the
+    handoff snapshot: everything applied before it is in the streamed
+    ranges, everything after it bounces. ``mode="activate"`` installs the
+    new epoch as current (count = to_count); a group whose index falls
+    outside to_count becomes "retired" and bounces everything forever.
+    Idempotent and never-downgrading within an epoch, so coordinator
+    retries and full log replays converge. Deterministic: ownership is
+    decided from the command's own fields + the ref hash — never a clock."""
+
+    group: int  # this group's index in the shard map
+    from_count: int  # group count of the epoch being left
+    to_count: int  # group count of the epoch being entered
+    epoch: int  # the shard-map epoch this fence installs
+    mode: str  # "seal" | "activate"
+    request_id: bytes
+    issued_at: float = 0.0
+
+
+@register
+@dataclass(frozen=True)
+class InstallShardStateCommand:
+    """Elastic-reshard state handoff frame: one chunk of the source group's
+    sealed `committed_states` / `reserved_states` ranges, replicated into
+    the TARGET group's log. Rows are the exact source blobs (the same
+    (state_ref, consuming) / (state_ref, tx_id, expires_at) shapes
+    InstallSnapshot already ships), applied INSERT OR IGNORE so coordinator
+    retries and log replays are idempotent. The first frame fences the
+    target as "importing" (all traffic bounces WRONG_EPOCH until the
+    coordinator activates it) — a new-epoch client that races ahead of the
+    cutover retries instead of committing against a half-installed ledger.
+    Reservation rows carry their original coordinator-stamped expires_at,
+    so a 2PC hold orphaned by a crashed handoff coordinator still releases
+    by TTL on the new owner (replicas never read clocks)."""
+
+    committed_rows: tuple  # ((state_ref_blob, consuming_blob), ...)
+    reserved_rows: tuple  # ((state_ref_blob, tx_id_bytes, expires_at), ...)
+    group: int  # the TARGET group's index at to_count
+    from_count: int
+    to_count: int
+    epoch: int
+    request_id: bytes
+    issued_at: float = 0.0
+
+
+@register
+@dataclass(frozen=True)
 class PutAllBatch:
     """Group commit: every PutAllCommand a leader's scheduling round
     coalesced, replicated as ONE log entry — one log append/fsync, one
@@ -254,6 +308,13 @@ class ClientReply:
     ok: bool
     conflict: UniquenessConflict | None
     leader_hint: str | None
+    # True when the command bounced off a shard-reshard fence (WRONG_EPOCH
+    # outcome): the ref now belongs to another group/epoch, so resubmitting
+    # HERE can never succeed — the submitter must re-derive the shard
+    # directory first. Wire-only (ClientReply is never persisted) and every
+    # process in a deployment runs the same code, so extending the frame is
+    # safe; pre-reshard traffic always sends the default False.
+    wrong_epoch: bool = False
 
 
 @register
@@ -313,6 +374,23 @@ class _Busy:
 
 
 BUSY = _Busy()
+
+
+class _WrongEpoch:
+    """Fourth apply outcome: the command touches refs this group no longer
+    (or does not yet) own under the shard-map epoch its fence installed.
+    Unlike BUSY, resubmitting to the SAME group can never succeed — the
+    submitter must re-derive the shard directory (flows/notary.py watches
+    the network map) and route to the new owner. Mapped by _apply_committed
+    to ClientReply(ok=False, conflict=None, wrong_epoch=True)."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "WRONG_EPOCH"
+
+
+WRONG_EPOCH = _WrongEpoch()
 
 
 class RaftMember:
@@ -1275,6 +1353,13 @@ class RaftMember:
                     # resolves or expires.
                     reply = ClientReply(cmd.request_id, False, None,
                                         self.leader_name)
+                elif outcome is WRONG_EPOCH:
+                    # Reshard fence: this group no longer/not yet owns the
+                    # refs. Retryable, but ONLY after the submitter
+                    # re-derives the shard directory — the flag tells its
+                    # poller to stop resubmitting here.
+                    reply = ClientReply(cmd.request_id, False, None,
+                                        self.leader_name, wrong_epoch=True)
                 else:
                     reply = ClientReply(cmd.request_id, outcome is None,
                                         outcome, self.leader_name)
@@ -1362,6 +1447,17 @@ class CommitTimeoutException(UniquenessUnavailableException):
     checkpoint replay so flows can branch on it live and post-restore."""
 
 
+@register_flow_exception
+class WrongShardEpochException(UniquenessUnavailableException):
+    """The group bounced the command off a reshard fence: under the shard
+    map the group currently enforces, it does not own (some of) the touched
+    StateRefs. Retryable — but unlike a leaderless bounce, resubmitting to
+    the SAME group can never succeed. The caller must re-derive the shard
+    directory (network map) and route to the owning group at the new epoch.
+    Subclasses UniquenessUnavailableException so catch sites that predate
+    resharding still treat it as a retriable non-conflict."""
+
+
 class RaftUniquenessProvider(UniquenessProvider):
     """UniquenessProvider facade over a RaftMember (reference:
     RaftUniquenessProvider.kt:44-115 — commit() submits PutAll and waits for
@@ -1418,7 +1514,8 @@ class RaftUniquenessProvider(UniquenessProvider):
             now = _time.monotonic()
             reply = self.member.decided.pop(request_id, None)
             if reply is not None:
-                decided = reply.ok or reply.conflict is not None
+                decided = (reply.ok or reply.conflict is not None
+                           or reply.wrong_epoch)
                 if decided and ctx is not None and _obs.ACTIVE is not None:
                     # submit -> decision, stitched under the notary flow.
                     # (A leaderless bounce is not a decision: the command
@@ -1434,6 +1531,13 @@ class RaftUniquenessProvider(UniquenessProvider):
                     return True
                 if reply.conflict is not None:
                     raise UniquenessException(reply.conflict)
+                if reply.wrong_epoch:
+                    # Reshard fence bounce: this group no longer (or does
+                    # not yet) own the refs. Resubmitting here is futile —
+                    # surface so the client re-derives the directory.
+                    raise WrongShardEpochException(
+                        f"group fenced off {tx_id} (reshard in progress; "
+                        f"re-derive the shard directory)")
                 state["submitted_at"] = 0.0  # no leader yet: resubmit below
             if now >= state["deadline"]:
                 raise CommitTimeoutException(
@@ -1485,11 +1589,62 @@ def make_apply_command(db) -> Callable[[Any], Any]:
     state — never on a local clock — so replicas applying the same log
     prefix always agree (reservation expiry compares the command's
     issued_at stamp against the stored expires_at)."""
+    # Lazy import: sharding imports raft at module level (commands,
+    # RaftMember), so the shard hash comes in at closure-build time instead
+    # of creating an import cycle. One definition, two layers.
+    from .sharding import shard_of
+
     with db.lock:
         # The member normally creates this table, but apply closures are
         # built before RaftMember.__init__ runs its schema script.
         db.conn.executescript(_RAFT_SCHEMA)
         db.conn.commit()
+        raw = db.get_setting("shard_fence")
+    # Reshard fence, cached across applies and persisted in settings so a
+    # restarted member rebuilds it BEFORE replaying the log (replay then
+    # re-installs the same fences idempotently — never-downgrade below).
+    fence: dict[str, Any] = {"state": json.loads(raw) if raw else None}
+    # Fence modes outrank each other within an epoch (a retried "seal" must
+    # not regress an already-activated cutover); a higher epoch always wins.
+    _RANK = {"sealed": 1, "importing": 1, "active": 2, "retired": 2}
+
+    def _set_fence(state: dict) -> None:
+        fence["state"] = state
+        db.set_setting("shard_fence", json.dumps(state))
+
+    def _fence_bounce(refs):
+        """WRONG_EPOCH iff the installed fence says some ref is not (or is
+        no longer) this group's to serve; None = proceed. Pure function of
+        the fence record + ref hashes — no clocks, no local state."""
+        f = fence["state"]
+        if not f:
+            return None
+        mode = f["mode"]
+        if mode == "sealed":
+            # Handoff in progress: the keyspace MOVING to another group is
+            # frozen at the seal's log position; refs this group keeps
+            # under the new epoch commit straight through (no outage for
+            # the unmoved majority).
+            cnt, g = f["to_count"], f["group"]
+            if g >= cnt:  # retiring group (merge): everything is moving
+                return WRONG_EPOCH
+            for ref in refs:
+                if shard_of(ref, cnt) != g:
+                    return WRONG_EPOCH
+            return None
+        if mode in ("importing", "retired"):
+            # importing: half-installed ledger — a racing new-epoch client
+            # must retry until the coordinator activates us. retired: a
+            # merged-away group never serves again.
+            return WRONG_EPOCH
+        # mode == "active": epoch installed. Bounce refs we don't own so a
+        # stale-directory client re-derives instead of committing against
+        # the wrong group's ledger (the split sibling has its history).
+        cnt, g = f["count"], f["group"]
+        for ref in refs:
+            if shard_of(ref, cnt) != g:
+                return WRONG_EPOCH
+        return None
 
     def _committed_conflicts(conn, refs, tx_id) -> dict:
         conflicts = {}
@@ -1519,6 +1674,9 @@ def make_apply_command(db) -> Callable[[Any], Any]:
     def _apply_put_all(cmd: PutAllCommand):
         with db.lock:
             conn = db.conn
+            bounced = _fence_bounce(cmd.refs)
+            if bounced is not None:
+                return bounced
             conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
             if conflicts:
                 return UniquenessConflict(conflicts)
@@ -1543,6 +1701,9 @@ def make_apply_command(db) -> Callable[[Any], Any]:
     def _apply_reserve(cmd: ReserveCommand):
         with db.lock:
             conn = db.conn
+            bounced = _fence_bounce(cmd.refs)
+            if bounced is not None:
+                return bounced
             conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
             if conflicts:
                 return UniquenessConflict(conflicts)
@@ -1563,6 +1724,9 @@ def make_apply_command(db) -> Callable[[Any], Any]:
     def _apply_commit_reserved(cmd: CommitReservedCommand):
         with db.lock:
             conn = db.conn
+            bounced = _fence_bounce(cmd.refs)
+            if bounced is not None:
+                return bounced
             conflicts = _committed_conflicts(conn, cmd.refs, cmd.tx_id)
             if conflicts:
                 return UniquenessConflict(conflicts)
@@ -1589,6 +1753,70 @@ def make_apply_command(db) -> Callable[[Any], Any]:
             db.commit()
             return None
 
+    def _apply_fence(cmd: ShardFenceCommand):
+        new_mode = ("sealed" if cmd.mode == "seal"
+                    else "retired" if cmd.group >= cmd.to_count
+                    else "active")
+        with db.lock:
+            f = fence["state"]
+            if f and ((f["epoch"], _RANK.get(f["mode"], 0))
+                      >= (cmd.epoch, _RANK[new_mode])):
+                return None  # coordinator retry / replay: never downgrade
+            _set_fence({"epoch": cmd.epoch, "group": cmd.group,
+                        "from_count": cmd.from_count,
+                        "to_count": cmd.to_count, "count": cmd.to_count,
+                        "mode": new_mode})
+            # Activation purges rows the group no longer owns. Safe: the
+            # coordinator activates the TARGET before the source, so by the
+            # time a source applies "active"/"retired" the moved rows are
+            # durable on the target's quorum. Keeping them instead would
+            # double-count the ledger audit (sum of per-group rows).
+            if new_mode == "retired":
+                db.conn.execute("DELETE FROM committed_states")
+                db.conn.execute("DELETE FROM reserved_states")
+            elif new_mode == "active":
+                for table in ("committed_states", "reserved_states"):
+                    gone = [
+                        (bytes(row[0]),)
+                        for row in db.conn.execute(
+                            f"SELECT state_ref FROM {table}").fetchall()
+                        if shard_of(deserialize(bytes(row[0])),
+                                    cmd.to_count) != cmd.group]
+                    if gone:
+                        db.conn.executemany(
+                            f"DELETE FROM {table} WHERE state_ref = ?",
+                            gone)
+            db.commit()
+            return None
+
+    def _apply_install(cmd: InstallShardStateCommand):
+        with db.lock:
+            conn = db.conn
+            f = fence["state"]
+            if not f or ((f["epoch"], _RANK.get(f["mode"], 0))
+                         < (cmd.epoch, 1)):
+                # First handoff frame fences the target as importing —
+                # WRONG_EPOCH to everyone until the coordinator activates.
+                _set_fence({"epoch": cmd.epoch, "group": cmd.group,
+                            "from_count": cmd.from_count,
+                            "to_count": cmd.to_count, "count": cmd.to_count,
+                            "mode": "importing"})
+            for blob, consuming in cmd.committed_rows:
+                conn.execute(
+                    "INSERT OR IGNORE INTO committed_states "
+                    "(state_ref, consuming) VALUES (?, ?)",
+                    (bytes(blob), bytes(consuming)))
+            for blob, tx_id, expires in cmd.reserved_rows:
+                # OR IGNORE: a retried frame never clobbers, and the hold
+                # keeps its original coordinator-stamped expires_at so the
+                # TTL backstop carries across the handoff unchanged.
+                conn.execute(
+                    "INSERT OR IGNORE INTO reserved_states "
+                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
+                    (bytes(blob), bytes(tx_id), float(expires)))
+            db.commit()
+            return None
+
     def apply(cmd):
         if isinstance(cmd, ReserveCommand):
             return _apply_reserve(cmd)
@@ -1596,6 +1824,10 @@ def make_apply_command(db) -> Callable[[Any], Any]:
             return _apply_commit_reserved(cmd)
         if isinstance(cmd, AbortReservedCommand):
             return _apply_abort(cmd)
+        if isinstance(cmd, ShardFenceCommand):
+            return _apply_fence(cmd)
+        if isinstance(cmd, InstallShardStateCommand):
+            return _apply_install(cmd)
         return _apply_put_all(cmd)
 
     return apply

@@ -54,7 +54,34 @@ into the local member's ``decided`` mailbox. The member resolves reply
 addresses beyond its own peers through the netmap resolver the node
 injects (RaftMember.resolve_addr).
 
-Failure matrix: ARCHITECTURE.md "Sharded notary (round 9)".
+Elastic resharding (round 13)
+-----------------------------
+The shard map is EPOCH'd: service strings carry ``@<epoch>`` past epoch 0
+and clients prefer the highest *complete* epoch when building the
+directory. A reshard is restricted to a doubling split (N -> 2N) or a
+halving merge (2M -> M) because the hash is consistent under exactly those
+moves: ``h % N == g`` implies ``h % 2N in {g, g+N}`` and
+``h % M == g % M`` — every target group receives keys from exactly ONE
+source group, so a single source leader can coordinate each handoff
+without cross-source agreement.
+
+The transition is a replicated fence + idempotent state stream
+(node/services/raft.py ShardFenceCommand / InstallShardStateCommand):
+the source group SEALS (its fence's log position linearizes the handoff
+snapshot — moved refs bounce ``WrongShardEpoch`` from that entry on),
+streams its moved ``committed_states``/``reserved_states`` rows to the
+target group (first frame fences the target ``importing``), ACTIVATES the
+target, then activates (or retires) itself — at which point activation
+purges the moved rows, so the ledger-side audit (sum of per-group rows)
+never double-counts. Every step is replicated and idempotent: a crashed
+coordinator is survived by the next leader of the source group re-running
+the whole sequence, and streamed reservations keep their original
+coordinator-stamped ``expires_at`` so the TTL backstop carries across the
+handoff unchanged. Clients that raced the transition get the retryable
+``WrongShardEpochException`` and re-derive the directory
+(flows/notary.py notarise_with_retry) — a p99 blip, not an outage.
+
+Failure matrix: ARCHITECTURE.md "Elastic resharding (round 13)".
 """
 
 from __future__ import annotations
@@ -67,22 +94,44 @@ from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
 from ...obs import trace as _obs
 from ...qos import context as _qos
+from ...serialization.codec import deserialize
+from ...testing import faults as _faults
 from .api import UniquenessException, UniquenessProvider
 from .raft import (
     AbortReservedCommand,
     ClientCommit,
     CommitReservedCommand,
     CommitTimeoutException,
+    InstallShardStateCommand,
     PutAllCommand,
     RaftMember,
     RaftUniquenessProvider,
     ReserveCommand,
+    ShardFenceCommand,
+    WrongShardEpochException,
 )
+
+__all__ = [  # re-exports: the fence exception is raised here and in raft
+    "SHARD_SERVICE_PREFIX", "RESHARD_PLAN_PREFIX", "RESHARD_PLAN_ENTRY",
+    "shard_of", "split_by_shard", "shard_service_string",
+    "parse_shard_service", "parse_shard_service_full",
+    "reshard_plan_string", "parse_reshard_plan", "publish_reshard_plan",
+    "ShardedUniquenessProvider", "WrongShardEpochException",
+]
 
 # Netmap service-string prefix: member of shard group g advertises
 # f"{SHARD_SERVICE_PREFIX}{g}of{count}" so clients recover both the group
 # id and the total shard count from the directory they already sync.
+# Past epoch 0 the string carries "@<epoch>" so a directory mixing old and
+# new advertisements is disambiguated by epoch, not by count alone.
 SHARD_SERVICE_PREFIX = "corda.notary.shard."
+
+# Reshard plans ride the SAME network map, as one service string on a
+# control pseudo-entry (name RESHARD_PLAN_ENTRY). Node.refresh_netmap
+# skips "_"-prefixed entries when building the party directory and parses
+# the plan out of them instead — no new channel, no new watcher.
+RESHARD_PLAN_PREFIX = "corda.notary.reshard."
+RESHARD_PLAN_ENTRY = "_reshard"
 
 
 def shard_of(ref, count: int) -> int:
@@ -103,23 +152,76 @@ def split_by_shard(refs, count: int) -> dict[int, tuple]:
     return {g: tuple(v) for g, v in by_group.items()}
 
 
-def shard_service_string(group: int, count: int) -> str:
-    return f"{SHARD_SERVICE_PREFIX}{group}of{count}"
+def shard_service_string(group: int, count: int, epoch: int = 0) -> str:
+    """Advertised service string for a group. Epoch 0 (the boot map) keeps
+    the original bare format so pre-reshard directories stay byte-stable;
+    later epochs append ``@<epoch>``."""
+    base = f"{SHARD_SERVICE_PREFIX}{group}of{count}"
+    return base if epoch <= 0 else f"{base}@{epoch}"
 
 
 def parse_shard_service(service: str) -> tuple[int, int] | None:
     """(group, count) from an advertised service string, else None."""
+    full = parse_shard_service_full(service)
+    return None if full is None else full[:2]
+
+
+def parse_shard_service_full(service: str) -> tuple[int, int, int] | None:
+    """(group, count, epoch) from an advertised service string, else None.
+    A bare (pre-reshard) string parses as epoch 0."""
     if not service.startswith(SHARD_SERVICE_PREFIX):
         return None
     tail = service[len(SHARD_SERVICE_PREFIX):]
+    tail, _, epoch_s = tail.partition("@")
     group_s, _, count_s = tail.partition("of")
     try:
         group, count = int(group_s), int(count_s)
+        epoch = int(epoch_s) if epoch_s else 0
     except ValueError:
         return None
-    if count <= 0 or not 0 <= group < count:
+    if count <= 0 or epoch < 0 or not 0 <= group < count:
         return None
-    return group, count
+    return group, count, epoch
+
+
+def reshard_plan_string(epoch: int, from_count: int, to_count: int) -> str:
+    return f"{RESHARD_PLAN_PREFIX}{epoch}:{from_count}to{to_count}"
+
+
+def parse_reshard_plan(service: str) -> tuple[int, int, int] | None:
+    """(epoch, from_count, to_count) from a plan service string, else None.
+    Only shape-valid plans parse: a doubling split or a halving merge with
+    a positive epoch (epoch 0 is the boot map and can never be a target)."""
+    if not service.startswith(RESHARD_PLAN_PREFIX):
+        return None
+    tail = service[len(RESHARD_PLAN_PREFIX):]
+    epoch_s, _, counts = tail.partition(":")
+    from_s, _, to_s = counts.partition("to")
+    try:
+        epoch, from_count, to_count = int(epoch_s), int(from_s), int(to_s)
+    except ValueError:
+        return None
+    if epoch <= 0 or from_count <= 0 or to_count <= 0:
+        return None
+    if to_count != 2 * from_count and from_count != 2 * to_count:
+        return None  # only doubling splits / halving merges are consistent
+    return epoch, from_count, to_count
+
+
+def publish_reshard_plan(network_map: str, epoch: int, from_count: int,
+                         to_count: int, owning_key) -> None:
+    """Publish (or supersede) the reshard plan through the network map.
+    The plan is one service string on a control pseudo-entry — every node
+    picks it up on its ordinary netmap refresh cadence; the affected source
+    group leaders start the handoff, everyone else just learns the epoch."""
+    plan = reshard_plan_string(epoch, from_count, to_count)
+    if parse_reshard_plan(plan) is None:
+        raise ValueError(
+            f"invalid reshard plan: epoch={epoch} {from_count}->{to_count} "
+            f"(only doubling splits / halving merges; epoch must be > 0)")
+    from ..config import netmap_register
+    netmap_register(network_map, RESHARD_PLAN_ENTRY, "0.0.0.0", 0,
+                    owning_key, (plan,))
 
 
 class ShardedUniquenessProvider(UniquenessProvider):
@@ -143,10 +245,23 @@ class ShardedUniquenessProvider(UniquenessProvider):
         self.timeout = timeout
         self._local = RaftUniquenessProvider(member, pump, timeout)
         self.count = int(shards.count)
+        self.epoch = 0
         self.groups = tuple(tuple(g) for g in shards.groups)
         self.ttl_s = float(shards.reserve_ttl_s)
+        # The groups list may be LONGER than count: groups >= count are
+        # PENDING split targets, booted and electable but owning no keys
+        # until a reshard epoch activates them.
         self.my_group = next(
             (i for i, g in enumerate(self.groups) if member.name in g), None)
+        # At most one live handoff this member coordinates (source leader).
+        self._reshard: dict | None = None
+        # Replay a persisted fence (restart mid- or post-reshard): the
+        # routing count/epoch must match what the group's state machine
+        # already enforces, or every local fast-path commit would bounce.
+        fence = self._read_fence()
+        if fence is not None and fence.get("mode") in ("active", "retired"):
+            self.count = int(fence["count"])
+            self.epoch = int(fence["epoch"])
         # Per-group preferred target member for the cross-group channel:
         # starts at the group's first member, follows leader hints from
         # bounce replies (satellite-1 semantics: hints are PER GROUP — a
@@ -159,6 +274,9 @@ class ShardedUniquenessProvider(UniquenessProvider):
             "remote_single": 0,   # single-group txs owned by another group
             "aborts_sent": 0,     # phase-1 failures unwound
             "reserve_retries": 0,  # busy/leaderless resubmissions, phase 1
+            "wrong_epoch": 0,     # fence bounces surfaced to callers
+            "handoff_frames": 0,  # InstallShardState frames acked (as src)
+            "resharded": 0,       # handoffs this member coordinated to done
         }
 
     # -- commit ------------------------------------------------------------
@@ -170,9 +288,20 @@ class ShardedUniquenessProvider(UniquenessProvider):
         touched = set(by_group)
         if not touched or touched == {self.my_group}:
             # Fast path: everything this member's own group owns — the
-            # exact unsharded protocol, byte-identical commands.
+            # exact unsharded protocol, byte-identical commands. Only the
+            # wrong_epoch accounting wraps it: a reshard fence can bounce
+            # the local group too, and the bench counts every bounce.
             self.metrics["single_shard"] += 1
-            return self._local.commit_async(refs, tx_id, caller_identity)
+            inner = self._local.commit_async(refs, tx_id, caller_identity)
+
+            def poll():
+                try:
+                    return inner()
+                except WrongShardEpochException:
+                    self.metrics["wrong_epoch"] += 1
+                    raise
+
+            return poll
         if len(touched) == 1:
             # Single foreign group: no atomicity to coordinate — one remote
             # PutAll through the cross-group channel (a 2PC would add a
@@ -196,7 +325,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
 
     def _new_op(self, group: int) -> dict:
         return {"group": group, "rid": os.urandom(16), "submitted_at": 0.0,
-                "done": False, "conflict": None}
+                "done": False, "conflict": None, "wrong_epoch": False}
 
     def _dispatch(self, op: dict, command) -> None:
         """Send one command toward its owning group: local group submits to
@@ -220,7 +349,7 @@ class ShardedUniquenessProvider(UniquenessProvider):
         otherwise (re)submit on the RESUBMIT_EVERY pace with a fresh
         issued_at stamp (same rid — idempotent through leader changes and
         deterministic against reservation expiry)."""
-        if op["done"] or op["conflict"] is not None:
+        if op["done"] or op["conflict"] is not None or op["wrong_epoch"]:
             return
         reply = self.member.decided.pop(op["rid"], None)
         if reply is not None:
@@ -229,6 +358,12 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 return
             if reply.conflict is not None:
                 op["conflict"] = reply.conflict
+                return
+            if reply.wrong_epoch:
+                # Reshard fence bounce: resubmitting to this group can
+                # never succeed — flag it and stop the pacing loop; the
+                # poll machine surfaces WrongShardEpochException.
+                op["wrong_epoch"] = True
                 return
             # Busy hold or leaderless bounce: follow the hint WITHIN this
             # group only, and let the pacing below resubmit.
@@ -277,6 +412,15 @@ class ShardedUniquenessProvider(UniquenessProvider):
             self._poll_op(op, make_command, now)
             if op["conflict"] is not None:
                 raise UniquenessException(op["conflict"])
+            if op["wrong_epoch"]:
+                self.metrics["wrong_epoch"] += 1
+                if ctx is not None and _obs.ACTIVE is not None:
+                    _obs.pop_link(op["rid"])
+                if qctx is not None and _qos.ACTIVE is not None:
+                    _qos.ACTIVE.pop_link(op["rid"])
+                raise WrongShardEpochException(
+                    f"group {group} fenced off {tx_id} (reshard in "
+                    f"progress; re-derive the shard directory)")
             if op["done"]:
                 if ctx is not None and _obs.ACTIVE is not None:
                     _obs.record("raft_commit", t0, _obs.now(),
@@ -364,6 +508,23 @@ class ShardedUniquenessProvider(UniquenessProvider):
                 _record_phase("shard_reserve" if state["phase"] == "reserve"
                               else "shard_commit")
                 raise UniquenessException(conflict)
+            if any(op["wrong_epoch"] for op in state["ops"].values()):
+                # A touched group resharded under this coordination. The
+                # whole 2PC must re-route: release what phase 1 took (best
+                # effort — an abort a sealed group bounces is covered by
+                # the streamed reservation + TTL backstop) and surface the
+                # retryable epoch error. A retry of the same tx_id at the
+                # new directory CONVERGES: reserve treats held-by-this-tx
+                # (including holds streamed during the handoff) as success
+                # and commit-reserved is idempotent.
+                if state["phase"] == "reserve":
+                    self._send_aborts(by_group, tx_id)
+                self.metrics["wrong_epoch"] += 1
+                _record_phase("shard_reserve" if state["phase"] == "reserve"
+                              else "shard_commit")
+                raise WrongShardEpochException(
+                    f"cross-shard {state['phase']} of {tx_id} bounced off "
+                    f"a reshard fence; re-derive the shard directory")
             if all(op["done"] for op in state["ops"].values()):
                 if state["phase"] == "reserve":
                     _record_phase("shard_reserve")
@@ -394,6 +555,187 @@ class ShardedUniquenessProvider(UniquenessProvider):
 
         return poll
 
+    # -- elastic resharding ------------------------------------------------
+
+    def _read_fence(self) -> dict | None:
+        """The group's APPLIED fence state (what its replicated state
+        machine currently enforces), from the member's settings table."""
+        import json
+        raw = self.member.db.get_setting("shard_fence")
+        return json.loads(raw) if raw else None
+
+    def reconfigure(self, count: int, epoch: int) -> None:
+        """Adopt a new shard-map epoch for ROUTING. Monotonic: an older or
+        equal epoch is a no-op (directory races must never roll the router
+        back). Correctness never depends on this — fences enforce; a stale
+        router just buys bounces and retries."""
+        if int(epoch) <= self.epoch:
+            return
+        self.count = int(count)
+        self.epoch = int(epoch)
+
+    def _reshard_role(self, from_count: int, to_count: int
+                      ) -> tuple[int, int] | None:
+        """(source_group, target_group) if this member's group hands state
+        off under the plan, else None. Split g -> {g, g+N}: sources are the
+        first N groups, targets the pending upper half. Merge: sources are
+        the retiring upper half, each folding into group g - M."""
+        g = self.my_group
+        if g is None:
+            return None
+        if to_count == 2 * from_count and g < from_count:
+            return g, g + from_count
+        if from_count == 2 * to_count and to_count <= g < from_count:
+            return g, g - to_count
+        return None
+
+    def _handoff_frames(self, target: int, to_count: int,
+                        rows_per_frame: int = 256) -> list:
+        """Snapshot the moved slice of this group's ledger, chunked for the
+        client channel. Read AFTER the seal is applied locally: the seal's
+        log position linearizes the snapshot — nothing can commit or
+        reserve a moved ref behind it, so the read is complete. Always at
+        least one (possibly empty) frame: the first frame is also what
+        fences the target ``importing``."""
+        db = self.member.db
+        with db.lock:
+            crows = db.conn.execute(
+                "SELECT state_ref, consuming FROM committed_states"
+            ).fetchall()
+            rrows = db.conn.execute(
+                "SELECT state_ref, tx_id, expires_at FROM reserved_states"
+            ).fetchall()
+        moved_c = [(bytes(b), bytes(c)) for b, c in crows
+                   if shard_of(deserialize(bytes(b)), to_count) == target]
+        moved_r = [(bytes(b), bytes(t), float(e)) for b, t, e in rrows
+                   if shard_of(deserialize(bytes(b)), to_count) == target]
+        frames, i = [], 0
+        while i < max(len(moved_c), len(moved_r)) or not frames:
+            frames.append((tuple(moved_c[i:i + rows_per_frame]),
+                           tuple(moved_r[i:i + rows_per_frame])))
+            i += rows_per_frame
+        return frames
+
+    def reshard_tick(self, plan: tuple[int, int, int] | None,
+                     now: float) -> None:
+        """Advance (at most) one live handoff this member coordinates.
+        Called every run-loop round by the node — non-blocking, one
+        outstanding command at a time, paced by _poll_op.
+
+        Only the CURRENT LEADER of a source group drives; followers and
+        deposed leaders drop their local progress dict, because every step
+        is replicated + idempotent and a new leader simply re-runs the
+        whole seal -> stream -> activate-target -> activate-self sequence
+        from its own applied state. Crash-mid-handoff (the
+        ``shard.handoff`` fault point) is therefore survived by the next
+        election, and streamed reservations keep their original
+        expires_at, so a coordinator that dies forever still releases its
+        holds by TTL."""
+        if self.member.role != "leader":
+            self._reshard = None
+            return
+        st = self._reshard
+        if st is None:
+            if plan is None:
+                return
+            epoch, from_count, to_count = plan
+            if epoch <= self.epoch:
+                return  # already adopted (or superseded): nothing to do
+            fence = self._read_fence()
+            if fence is not None and int(fence.get("epoch", 0)) >= epoch \
+                    and fence.get("mode") in ("active", "retired"):
+                # Applied state says the handoff finished (e.g. this member
+                # just won an election after the old coordinator completed
+                # everything but its own routing bump).
+                self.reconfigure(int(fence["count"]), int(fence["epoch"]))
+                return
+            role = self._reshard_role(from_count, to_count)
+            if role is None:
+                return  # not a source group: fences/netmap carry the news
+            src, target = role
+            st = self._reshard = {
+                "epoch": epoch, "from": from_count, "to": to_count,
+                "src": src, "target": target, "stage": "seal",
+                "op": None, "frames": None, "frame_idx": 0,
+                "t0": _obs.now() if _obs.ACTIVE is not None else 0.0,
+            }
+        e, fc, tc = st["epoch"], st["from"], st["to"]
+        if st["stage"] == "seal":
+            if st["op"] is None:
+                st["op"] = self._new_op(st["src"])
+            self._poll_op(
+                st["op"],
+                lambda op: ShardFenceCommand(st["src"], fc, tc, e, "seal",
+                                             op["rid"]),
+                now)
+            if st["op"]["done"]:
+                st["stage"], st["op"] = "stream", None
+            return
+        if st["stage"] == "stream":
+            if st["frames"] is None:
+                st["frames"] = self._handoff_frames(st["target"], tc)
+            if st["frame_idx"] >= len(st["frames"]):
+                st["stage"], st["op"] = "activate_target", None
+                return
+            if st["op"] is None:
+                st["op"] = self._new_op(st["target"])
+                # Chaos hook, fired once per streamed frame: drop models a
+                # lost frame (first send deferred one pacing interval —
+                # the idempotent resubmit recovers), stall a slow link,
+                # crash the coordinator-death-mid-handoff case.
+                if _faults.ACTIVE is not None:
+                    act = _faults.ACTIVE.fire("shard.handoff")
+                    if act is not None:
+                        action, delay_s = act
+                        if action == "drop":
+                            st["op"]["submitted_at"] = now
+                        elif delay_s > 0.0:
+                            _time.sleep(delay_s)
+            committed, reserved = st["frames"][st["frame_idx"]]
+            self._poll_op(
+                st["op"],
+                lambda op: InstallShardStateCommand(
+                    committed, reserved, st["target"], fc, tc, e,
+                    op["rid"]),
+                now)
+            if st["op"]["done"]:
+                self.metrics["handoff_frames"] += 1
+                st["frame_idx"] += 1
+                st["op"] = None
+            return
+        if st["stage"] == "activate_target":
+            if st["op"] is None:
+                st["op"] = self._new_op(st["target"])
+            self._poll_op(
+                st["op"],
+                lambda op: ShardFenceCommand(st["target"], fc, tc, e,
+                                             "activate", op["rid"]),
+                now)
+            if st["op"]["done"]:
+                st["stage"], st["op"] = "activate_self", None
+            return
+        if st["stage"] == "activate_self":
+            # Target is durably active first: from here the moved rows
+            # exist on the target's quorum, so purging them at our own
+            # activation (raft.py _apply_fence) cannot lose state.
+            if st["op"] is None:
+                st["op"] = self._new_op(st["src"])
+            self._poll_op(
+                st["op"],
+                lambda op: ShardFenceCommand(st["src"], fc, tc, e,
+                                             "activate", op["rid"]),
+                now)
+            if st["op"]["done"]:
+                if _obs.ACTIVE is not None:
+                    _obs.record("shard_handoff", st["t0"], _obs.now(),
+                                attrs={"epoch": e, "from": fc, "to": tc,
+                                       "src": st["src"],
+                                       "target": st["target"],
+                                       "frames": len(st["frames"] or ())})
+                self.metrics["resharded"] += 1
+                self._reshard = None
+                self.reconfigure(tc, e)
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -412,10 +754,14 @@ class ShardedUniquenessProvider(UniquenessProvider):
         m = self.metrics
         return {
             "shards": self.count,
+            "epoch": self.epoch,
             "my_group": self.my_group,
             "single_shard": m["single_shard"],
             "remote_single": m["remote_single"],
             "cross_shard": m["cross_shard"],
             "aborts_sent": m["aborts_sent"],
             "reserve_retries": m["reserve_retries"],
+            "wrong_epoch": m["wrong_epoch"],
+            "handoff_frames": m["handoff_frames"],
+            "resharded": m["resharded"],
         }

@@ -44,17 +44,20 @@ BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
 # Per-trace measured stage spans. shard_reserve/shard_commit are the two
 # phases of the cross-shard 2PC coordinator (node/services/sharding.py).
 # admission_wait is the client-side backoff park after an OverloadedError
-# shed (flows/notary.py); lane_queue_wait is time spent runnable behind
+# shed (flows/notary.py); epoch_wait is the same park when the request
+# bounced off a reshard fence (WrongShardEpoch) and the client re-derives
+# the shard directory; lane_queue_wait is time spent runnable behind
 # the QoS lane scheduler before the pump picked the flow (statemachine).
-DIRECT_STAGES = ("verify_wait", "admission_wait", "lane_queue_wait",
-                 "shard_reserve", "shard_commit")
+DIRECT_STAGES = ("verify_wait", "admission_wait", "epoch_wait",
+                 "lane_queue_wait", "shard_reserve", "shard_commit")
 
 # Derived by stage_breakdown, never recorded: the reply tail is
 # root_end - max(attributed stage end).
 DERIVED_STAGES = ("reply",)
 
 # Full breakdown order the bench report presents.
-STAGES = ("admission_wait", "queue_wait", "lane_queue_wait", "verify_wait",
+STAGES = ("admission_wait", "epoch_wait", "queue_wait", "lane_queue_wait",
+          "verify_wait",
           "device_verify", "sidecar_wait", "sidecar_verify",
           "shard_reserve", "shard_commit",
           "raft_append", "fsync", "replication", "reply")
@@ -62,8 +65,11 @@ STAGES = ("admission_wait", "queue_wait", "lane_queue_wait", "verify_wait",
 # Stitch markers: recorded per trace to bound the derived reply tail and
 # anchor cross-node correlation, but not themselves breakdown stages.
 # qos_flush marks a deadline-triggered early flush/seal at one of the
-# three QoS queueing points (attrs["point"] names which).
-MARKER_SPANS = ("raft_commit", "notary_process", "qos_flush")
+# three QoS queueing points (attrs["point"] names which); shard_handoff
+# is recorded once per completed reshard handoff by the source-group
+# coordinator (attrs carry epoch/from/to/frames).
+MARKER_SPANS = ("raft_commit", "notary_process", "qos_flush",
+                "shard_handoff")
 
 # Dynamic span families: a recorded name may start with one of these
 # prefixes (the root flow span is f"flow:{FlowClassName}").

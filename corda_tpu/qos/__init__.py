@@ -7,6 +7,7 @@ _qos``) so the one-attribute disarmed check stays cheap and explicit.
 """
 
 from .admission import AdmissionController, TokenBucket
+from .calibrate import apply_calibration, calibrate_admission
 from .context import (LANES, LANE_BULK, LANE_INTERACTIVE, QosContext,
                       QosPlane, arm, arm_from_env, clear_context, disarm,
                       get_context, set_context)
@@ -18,6 +19,8 @@ from .context import (LANES, LANE_BULK, LANE_INTERACTIVE, QosContext,
 
 __all__ = [
     "AdmissionController",
+    "apply_calibration",
+    "calibrate_admission",
     "LANES",
     "LANE_BULK",
     "LANE_INTERACTIVE",

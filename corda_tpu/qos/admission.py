@@ -116,6 +116,30 @@ class AdmissionController:
             self.counters[f"shed_{lane}"] += 1
             return max(0.01, bucket.retry_after_s())
 
+    def reconfigure(self, interactive_rate: float | None = None,
+                    interactive_burst: float | None = None,
+                    bulk_rate: float | None = None,
+                    bulk_burst: float | None = None,
+                    queue_watermark: int | None = None) -> None:
+        """Swap in new rates live (measured-saturation calibration: the
+        rates come from an observed slo_sweep, qos/calibrate.py, not static
+        TOML). Each lane's bucket is REPLACED, not mutated — a fresh bucket
+        starts full at the new burst, so a recalibration never inherits a
+        deficit accumulated under the old (possibly wrong) rate. ``None``
+        keeps the current value for that knob; counters are preserved."""
+        with self._lock:
+            for lane, rate, burst in (
+                    (LANE_INTERACTIVE, interactive_rate, interactive_burst),
+                    (LANE_BULK, bulk_rate, bulk_burst)):
+                if rate is None and burst is None:
+                    continue
+                old = self._buckets[lane]
+                self._buckets[lane] = TokenBucket(
+                    old.rate if rate is None else rate,
+                    (old.burst or 32.0) if burst is None else burst)
+            if queue_watermark is not None:
+                self.queue_watermark = int(queue_watermark)
+
     def stats(self) -> dict:
         with self._lock:
             return {

@@ -314,18 +314,23 @@ def render_node_config(name: str, node_dir, netmap, notary: str = "none",
     return "\n".join(lines) + "\n"
 
 
-def shard_groups_toml(groups, reserve_ttl_s: float = 15.0) -> str:
+def shard_groups_toml(groups, reserve_ttl_s: float = 15.0,
+                      count: int | None = None) -> str:
     """The `[notary_shards]` fragment for a sharded-notary topology
     (services/sharding.py): identical text for every member — each node
     derives its own group from its own name. `groups` is a sequence of
-    member-name sequences, index = shard id. NOTE: this opens a TOML table,
-    so when composing extra_toml put this fragment LAST among bare keys
-    (the same ordering rule render_node_config applies to [[rpc_users]])."""
+    member-name sequences, index = shard id. `count` below len(groups)
+    marks the trailing groups as PENDING split targets (booted and
+    electable but owning no keyspace until a reshard epoch activates
+    them). NOTE: this opens a TOML table, so when composing extra_toml put
+    this fragment LAST among bare keys (the same ordering rule
+    render_node_config applies to [[rpc_users]])."""
+    groups = list(groups)
     rows = ",\n  ".join(
         "[" + ", ".join(_toml_escape(str(m)) for m in g) + "]"
         for g in groups)
     return ("[notary_shards]\n"
-            f"count = {len(list(groups))}\n"
+            f"count = {len(groups) if count is None else int(count)}\n"
             f"reserve_ttl_s = {_toml_escape(float(reserve_ttl_s))}\n"
             "groups = [\n  " + rows + ",\n]")
 
@@ -419,17 +424,20 @@ class Driver:
                             device_member: tuple[int, int] | None = None,
                             env_extra: dict | None = None,
                             wait: bool = True,
-                            prefix: str = "Shard") -> list:
+                            prefix: str = "Shard",
+                            count: int | None = None) -> list:
         """Boot a sharded notary: `groups` independent Raft groups of
         `members` nodes each (names Shard0A, Shard0B, ... Shard1A, ...),
         every member carrying the same [notary_shards] map so each derives
         its group from its own name. Returns handles indexed
         [group][member]. `device_member` names the single (group, member)
         that owns the accelerator (production placement: one chip, one
-        process); everyone else stays on the host path."""
+        process); everyone else stays on the host path. `count` below
+        `groups` boots the trailing groups as pending split targets for a
+        live reshard (publish_reshard_plan activates them)."""
         names = [[f"{prefix}{g}{chr(ord('A') + m)}" for m in range(members)]
                  for g in range(groups)]
-        shard_toml = shard_groups_toml(names, reserve_ttl_s)
+        shard_toml = shard_groups_toml(names, reserve_ttl_s, count=count)
         merged = (extra_toml + "\n" + shard_toml) if extra_toml else shard_toml
         handles = []
         for g, group_names in enumerate(names):

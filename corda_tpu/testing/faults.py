@@ -18,7 +18,14 @@ point               fired from                                     actions
 ``raft.fsync``      RaftMember log append (sqlite insert+commit)   fail, stall, crash
 ``verify.device``   AsyncVerifyService feeder thread               fail, slow, crash
 ``checkpoint.write`` SMM ``_write_checkpoint``                     fail, stall, crash
+``shard.handoff``   reshard coordinator, per streamed state frame  drop, stall, crash
+``netmap.refresh``  Node ``refresh_netmap`` (directory reload)     drop, stall, crash
 ==================  =============================================  =======================================
+
+``shard.handoff`` crash is the coordinator-death-mid-handoff case (the
+next leader of the source group re-runs the idempotent sequence);
+``netmap.refresh`` drop keeps a node routing on a stale shard directory —
+its requests bounce ``WrongShardEpoch`` until a later refresh lands.
 
 Determinism: every rule owns a ``random.Random`` seeded from
 ``(plan seed, point, rule index)``, and probability draws consume that
@@ -76,6 +83,8 @@ POINTS = (
     "raft.fsync",
     "verify.device",
     "checkpoint.write",
+    "shard.handoff",
+    "netmap.refresh",
 )
 
 # Exit code used by the "crash" action so harnesses can tell an injected
@@ -254,12 +263,22 @@ def arm_from_env(node_name: str | None = None) -> FaultPlan | None:
 
 def builtin_plan(name: str, node_name: str | None = None) -> FaultPlan:
     """Named plans for the chaos loadtest / bench (``lossy``, ``slow-disk``,
-    ``flaky-device``)."""
+    ``flaky-device``, ``reshard``)."""
     if name == "lossy":
         # ~5% send-side loss; durable outbox re-poll recovers each loss
         # within ~1s, so the run completes with elevated tail latency.
         return FaultPlan(7, [
             FaultRule("transport.send", "drop", p=0.05, max_fires=500),
+        ], node_name=node_name)
+    if name == "reshard":
+        # The reshard-under-fire plan: lossy transport THROUGH the
+        # transition plus handoff-frame loss and one stale-directory
+        # window, so the exactly-once audit exercises resubmitted install
+        # frames and WrongShardEpoch bounces, not just the happy path.
+        return FaultPlan(17, [
+            FaultRule("transport.send", "drop", p=0.05, max_fires=500),
+            FaultRule("shard.handoff", "drop", p=0.25, max_fires=8),
+            FaultRule("netmap.refresh", "drop", p=0.10, max_fires=20),
         ], node_name=node_name)
     if name == "slow-disk":
         return FaultPlan(11, [

@@ -560,6 +560,298 @@ def run_chaos_loadtest(
 
 
 @dataclass
+class ReshardResult:
+    """One live-reshard run: the group count changes MID-LOAD and the
+    audit proves nobody noticed except the tail. Windows split the per-tx
+    latencies at the plan-publish and handoff-complete marks, so the p99
+    blip is measured, not asserted."""
+
+    plan: str | None
+    epoch: int
+    from_shards: int
+    to_shards: int
+    direction: str  # "split" | "merge"
+    tx_requested: int
+    tx_committed: int
+    tx_rejected: int
+    tx_unresolved: int  # flows that never completed (MUST be 0)
+    exactly_once: bool  # committed==requested, ledger rows == expected
+    cluster_committed: int
+    per_group_committed: list
+    reserved_leaked: int | None
+    cross_requested: int
+    wrong_epoch_bounces: int  # fence bounces served (client retry driver)
+    handoff_frames: int       # InstallShardState frames acked
+    reshard_started_s: float | None   # plan publish, since t0
+    reshard_completed_s: float | None  # every member at the new epoch
+    duration_s: float
+    tx_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    p99_before_ms: float  # completions before the plan published
+    p99_during_ms: float  # completions inside the transition window
+    p99_after_ms: float   # completions after every member cut over
+    faults_injected: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+def run_reshard_loadtest(
+    plan="reshard",  # FaultPlan | builtin name | plan TOML path | None
+    n_tx: int = 240,
+    shards: int = 2,
+    to_shards: int = 4,
+    cluster_size: int = 1,
+    verifier: str = "cpu",
+    batch: BatchConfig | None = None,
+    base_dir: str | None = None,
+    max_seconds: float = 240.0,
+    rate_tx_s: float = 40.0,
+    retry_deadline_s: float = 60.0,
+    reserve_ttl_s: float = 15.0,
+    cross_frac: float = 0.0,
+    reshard_after_frac: float = 0.3,
+    epoch: int = 1,
+) -> ReshardResult:
+    """Live shard split/merge under load (and, by default, under the
+    lossy `reshard` chaos plan): boot max(shards, to_shards) Raft groups
+    with count=shards (the extra groups are pending split targets), pace
+    an open loop of moves through RetryingNotariseFlow, publish the
+    reshard plan through the netmap once `reshard_after_frac` of the load
+    is submitted, and keep driving while the source leaders seal, stream,
+    and cut over. The run audits the same exactly-once contract as the
+    chaos harness — every tx committed exactly once, ledger rows across
+    groups total exactly the consumed refs, zero leaked reservations —
+    plus the reshard-specific story: bounded WrongShardEpoch retries and
+    a p99 blip confined to the transition window."""
+    from ..testing import faults
+
+    if to_shards != 2 * shards and shards != 2 * to_shards:
+        raise ValueError(
+            f"reshard must double or halve: {shards} -> {to_shards}")
+    direction = "split" if to_shards > shards else "merge"
+    plan_obj = None
+    if plan is not None:
+        if isinstance(plan, faults.FaultPlan):
+            plan_obj = plan
+        elif isinstance(plan, (str, Path)):
+            p = Path(plan)
+            if p.suffix == ".toml" or p.exists():
+                plan_obj = faults.plan_from_toml(
+                    p.read_text(encoding="utf-8"))
+            else:
+                plan_obj = faults.builtin_plan(str(plan))
+        else:
+            raise TypeError(f"plan: expected FaultPlan/str/Path, got {plan!r}")
+
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-reshard-"))
+    batch = batch or BatchConfig()
+    from ..node.config import ShardConfig
+    from ..node.services.sharding import publish_reshard_plan, shard_of
+
+    n_groups = max(shards, to_shards)
+    groups = tuple(
+        tuple(f"Shard{g}{chr(ord('A') + m)}" for m in range(cluster_size))
+        for g in range(n_groups))
+    shard_cfg = ShardConfig(count=shards, groups=groups,
+                            reserve_ttl_s=reserve_ttl_s)
+    notaries: list[Node] = []
+    group_nodes: list[list[Node]] = []
+    if plan_obj is not None:
+        faults.arm(plan_obj)
+    try:
+        for names in shard_cfg.groups:
+            row = [_make_node(
+                base, name, notary="raft-simple", raft_cluster=names,
+                notary_shards=shard_cfg, verifier=verifier, batch=batch)
+                for name in names]
+            group_nodes.append(row)
+            notaries.extend(row)
+        client = _make_node(base, "ReshardClient", verifier=verifier,
+                            batch=batch)
+        nodes = notaries + [client]
+        for n in nodes:
+            n.refresh_netmap()
+        deadline = time.monotonic() + 20.0 + 10.0 * len(group_nodes)
+        while time.monotonic() < deadline:
+            for n in nodes:
+                n.run_once(timeout=0.005)
+            if all(any(n.raft_member.role == "leader" for n in row)
+                   for row in group_nodes):
+                break
+        else:
+            raise RuntimeError("raft group(s) failed to elect")
+
+        target = notaries[0].identity
+        cross_every = round(1.0 / cross_frac) if cross_frac > 0.0 else 0
+        cross_requested = 0
+        stxs = []
+
+        def _issue(i: int) -> object:
+            builder = DummyContract.generate_initial(
+                client.identity.ref((i % (1 << 30)).to_bytes(4, "big")),
+                i, target)
+            builder.sign_with(client.key)
+            issue_stx = builder.to_signed_transaction()
+            client.services.record_transactions([issue_stx])
+            return issue_stx.tx.out_ref(0)
+
+        for i in range(n_tx):
+            priors = [_issue(i)]
+            if cross_every and shards > 1 and i % cross_every == 0:
+                cross_requested += 1
+                for attempt in range(1, 17):
+                    p2 = _issue(i + n_tx * attempt)
+                    if (shard_of(p2.ref, shards)
+                            != shard_of(priors[0].ref, shards)):
+                        break
+                priors.append(p2)
+            move = DummyContract.move(priors, client.identity.owning_key)
+            move.sign_with(client.key)
+            stxs.append(move.to_signed_transaction(
+                check_sufficient_signatures=False))
+
+        t0 = time.perf_counter()
+        samples: list[tuple[float, float]] = []  # (completed_at, latency)
+        handles = []
+        submitted = 0
+        started_at: float | None = None
+        completed_at: float | None = None
+        run_deadline = time.monotonic() + max_seconds
+        while time.monotonic() < run_deadline:
+            now = time.perf_counter() - t0
+            while submitted < n_tx and (
+                    rate_tx_s <= 0 or now >= submitted / rate_tx_s):
+                sched = submitted / rate_tx_s if rate_tx_s > 0 else 0.0
+                h = client.start_flow(RetryingNotariseFlow(
+                    stxs[submitted], retry_deadline_s))
+
+                def _done(_f, sched=sched):
+                    t = time.perf_counter() - t0
+                    samples.append((t, t - sched))
+
+                h.result.add_done_callback(_done)
+                handles.append(h)
+                submitted += 1
+                if rate_tx_s > 0:
+                    now = time.perf_counter() - t0
+            if started_at is None and submitted >= max(
+                    1, int(n_tx * reshard_after_frac)):
+                # Doubling (or halving) the group count MID-LOAD: the plan
+                # rides the shared netmap; source-group leaders pick it up
+                # on their next refresh cadence and start the handoff.
+                publish_reshard_plan(base / "netmap.json", epoch,
+                                     shards, to_shards,
+                                     client.identity.owning_key)
+                started_at = time.perf_counter() - t0
+            for n in nodes:
+                n.run_once(timeout=0.002)
+                n.refresh_netmap_maybe(0.25)
+            if (started_at is not None and completed_at is None
+                    and all(getattr(n.uniqueness_provider, "epoch", 0)
+                            >= epoch for n in notaries)):
+                completed_at = time.perf_counter() - t0
+            if (submitted == n_tx
+                    and sum(1 for h in handles if h.result.done) == n_tx
+                    and completed_at is not None):
+                break
+        duration = time.perf_counter() - t0
+
+        committed = rejected = unresolved = 0
+        for h in handles:
+            if not h.result.done:
+                unresolved += 1
+            elif h.result.exception() is None:
+                committed += 1
+            else:
+                rejected += 1
+        unresolved += n_tx - submitted
+        # Ledger-side audit at the NEW topology: activation purged every
+        # moved row from its source group, so across groups the rows must
+        # total exactly the consumed refs — fewer is a lost commit, more
+        # is a double-count that survived the handoff.
+        per_group_committed = [
+            max((n.uniqueness_provider.committed_count for n in row
+                 if getattr(n, "uniqueness_provider", None) is not None),
+                default=0)
+            for row in group_nodes]
+        cluster_committed = sum(per_group_committed)
+        expected_rows = n_tx + cross_requested
+        reserved_leaked = sum(
+            min((n.raft_member.stamp()["reserved_states"]
+                 for n in row), default=0)
+            for row in group_nodes)
+        wrong_epoch = sum(
+            n.uniqueness_provider.metrics.get("wrong_epoch", 0)
+            for n in notaries
+            if hasattr(n.uniqueness_provider, "metrics"))
+        frames = sum(
+            n.uniqueness_provider.metrics.get("handoff_frames", 0)
+            for n in notaries
+            if hasattr(n.uniqueness_provider, "metrics"))
+
+        def _p99(window) -> float:
+            srt = sorted(window)
+            if not srt:
+                return 0.0
+            return round(1e3 * srt[min(len(srt) - 1,
+                                       int(len(srt) * 0.99))], 2)
+
+        lat = [l for _, l in samples] or [0.0]
+        srt = sorted(lat)
+        before = [l for t, l in samples
+                  if started_at is not None and t < started_at]
+        during = [l for t, l in samples
+                  if started_at is not None and t >= started_at
+                  and (completed_at is None or t < completed_at)]
+        after = [l for t, l in samples
+                 if completed_at is not None and t >= completed_at]
+        result = ReshardResult(
+            plan=(getattr(plan, "name", None) or str(plan)
+                  if not isinstance(plan, faults.FaultPlan) else "custom")
+                 if plan is not None else None,
+            epoch=epoch,
+            from_shards=shards,
+            to_shards=to_shards,
+            direction=direction,
+            tx_requested=n_tx,
+            tx_committed=committed,
+            tx_rejected=rejected,
+            tx_unresolved=unresolved,
+            exactly_once=(committed == n_tx and rejected == 0
+                          and unresolved == 0
+                          and cluster_committed == expected_rows
+                          and not reserved_leaked),
+            cluster_committed=cluster_committed,
+            per_group_committed=per_group_committed,
+            reserved_leaked=reserved_leaked,
+            cross_requested=cross_requested,
+            wrong_epoch_bounces=wrong_epoch,
+            handoff_frames=frames,
+            reshard_started_s=(round(started_at, 3)
+                               if started_at is not None else None),
+            reshard_completed_s=(round(completed_at, 3)
+                                 if completed_at is not None else None),
+            duration_s=round(duration, 3),
+            tx_per_sec=round(committed / duration, 1) if duration else 0.0,
+            p50_ms=round(1e3 * srt[len(srt) // 2], 2),
+            p99_ms=_p99(lat),
+            p99_before_ms=_p99(before),
+            p99_during_ms=_p99(during),
+            p99_after_ms=_p99(after),
+            faults_injected=(plan_obj.injected() if plan_obj is not None
+                             else faults.injected()),
+        )
+        for n in nodes:
+            n.stop()
+        return result
+    finally:
+        if plan_obj is not None:
+            faults.disarm()
+
+
+@dataclass
 class MultiProcessResult:
     """Aggregate over C client processes firehosing one notary (cluster)."""
 
@@ -706,6 +998,9 @@ def run_loadtest_multiprocess(
     sidecar_devices: int = 0,  # > 1: the sidecar owns an N-device mesh and
     # shards each coalesced bucket data-parallel across it (ops/sharded.py;
     # a virtual CPU mesh when notary_device == "cpu")
+    adaptive_coalesce: bool = False,  # sidecar picks its own coalesce
+    # window from observed arrival gaps (crypto/sidecar.py controller;
+    # PR 7, off by default — flip per run to A/B against the static window)
     shards: int = 0,  # > 0: boot `shards` independent raft groups of
     # `cluster_size` members each, partitioned by StateRef hash
     # (node/services/sharding.py); requires a raft-flavoured `notary`
@@ -755,7 +1050,8 @@ def run_loadtest_multiprocess(
             side = d.start_sidecar(
                 verifier=verifier, device=notary_device,
                 coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
-                devices=sidecar_devices or None, env_extra=trace_env)
+                devices=sidecar_devices or None,
+                adaptive_coalesce=adaptive_coalesce, env_extra=trace_env)
         side_addr = side.address if side is not None else ""
         toml_extra = _extra(verifier, side_addr)
         # Followers stay on the host crypto path even when the leader runs

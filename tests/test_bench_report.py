@@ -23,9 +23,11 @@ def _stub_phases(monkeypatch):
                  "bench_raft_open_loop",  # unstubbed, this one ran a REAL
                  # multiprocess raft sweep (and now a sidecar) inside every
                  # report test — minutes of suite time measuring nothing
+                 "bench_validating_flagship",  # ditto: TWO flagship runs
                  "bench_shard_scaling",  # ditto: boots up to 4 raft groups
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
                  "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
+                 "bench_reshard",  # ditto: live split + merge in-process nets
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -70,6 +72,13 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # host-only path asserts it separately; schema parity both ways.
     assert report["baseline_configs"]["slo_sweep"] == {
         "stub": "bench_slo_sweep"}
+    # The live-reshard section (round 13) rides the device phase path —
+    # the host-only path asserts it separately; schema parity both ways.
+    assert report["baseline_configs"]["reshard"] == {
+        "stub": "bench_reshard"}
+    # The flagship is the adaptive-coalesce A/B wrapper on both paths.
+    assert report["baseline_configs"]["raft_validating_3node"] == {
+        "stub": "bench_validating_flagship"}
     assert "phase" not in report
 
 
@@ -129,6 +138,10 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_multichip_scaling"}
     assert report["baseline_configs"]["slo_sweep"] == {
         "stub": "bench_slo_sweep"}
+    assert report["baseline_configs"]["reshard"] == {
+        "stub": "bench_reshard"}
+    assert report["baseline_configs"]["raft_validating_3node"] == {
+        "stub": "bench_validating_flagship"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
 
 
@@ -479,6 +492,133 @@ def test_slo_sweep_report_contract(monkeypatch):
     miss = bench.bench_slo_sweep(rates=(240.0,), slo_ms=250.0)
     assert miss["verdict"]["interactive_p99_within_slo"] is False
     assert miss["verdict"]["slo_met"] is False
+
+    # Measured-saturation calibration rides the section: derived per-lane
+    # admission rates with provenance, serializable, and honest about a
+    # sweep where no rate met the SLO.
+    cal = out["calibration"]
+    json.dumps(cal)
+    assert cal["met_slo"] is True
+    assert cal["saturation_rate"] == 240.0
+    assert cal["interactive_rate"] > 0 and cal["bulk_rate"] > 0
+    assert miss["calibration"]["met_slo"] is False
+
+
+def _fake_reshard_result(**over):
+    base = dict(
+        plan="reshard", epoch=1, from_shards=2, to_shards=4,
+        direction="split", tx_requested=200, tx_committed=200,
+        tx_rejected=0, tx_unresolved=0, exactly_once=True,
+        cluster_committed=240, per_group_committed=[60, 60, 60, 60],
+        reserved_leaked=0, cross_requested=40, wrong_epoch_bounces=6,
+        handoff_frames=4, reshard_started_s=1.0, reshard_completed_s=1.8,
+        duration_s=5.0, tx_per_sec=40.0, p50_ms=80.0, p99_ms=300.0,
+        p99_before_ms=100.0, p99_during_ms=280.0, p99_after_ms=120.0,
+        faults_injected={"shard.handoff:drop": 2})
+    base.update(over)
+    from corda_tpu.tools.loadtest import ReshardResult
+    return ReshardResult(**base)
+
+
+def test_reshard_report_contract(monkeypatch):
+    """The reshard section's one-line-JSON contract: a chaos-armed live
+    SPLIT followed by a clean MERGE back, with the headline verdict keys
+    hoisted flat (exactly_once across BOTH runs, bounded wrong_epoch
+    bounces, the transition window, and the before/during/after p99s that
+    substantiate 'a blip, not an outage') — trend tooling greps these
+    flat on the device and host-only phase paths alike."""
+    from corda_tpu.tools import loadtest
+
+    calls = []
+
+    def fake_reshard(**kw):
+        calls.append(kw)
+        if kw.get("plan") == "reshard":
+            return _fake_reshard_result()
+        return _fake_reshard_result(
+            plan=None, from_shards=4, to_shards=2, direction="merge",
+            wrong_epoch_bounces=2, cross_requested=0, cluster_committed=100,
+            tx_requested=100, tx_committed=100,
+            per_group_committed=[50, 50, 0, 0], faults_injected={})
+
+    monkeypatch.setattr(loadtest, "run_reshard_loadtest", fake_reshard)
+    out = bench.bench_reshard(n_tx=200, rate_tx_s=80.0)
+
+    json.dumps(out)  # the one-line contract: fully serializable
+    # The split ran under the armed builtin chaos plan; the merge clean,
+    # with the shard counts swapped back.
+    assert calls[0]["plan"] == "reshard" and calls[0]["cross_frac"] == 0.2
+    assert (calls[0]["shards"], calls[0]["to_shards"]) == (2, 4)
+    assert calls[1]["plan"] is None
+    assert (calls[1]["shards"], calls[1]["to_shards"]) == (4, 2)
+    # Headline keys, flat.
+    assert out["exactly_once"] is True
+    assert out["wrong_epoch_bounces"] == 6
+    assert out["handoff_frames"] == 4
+    assert out["reshard_window_s"] == 0.8
+    assert out["p99_before_ms"] == 100.0
+    assert out["p99_during_ms"] == 280.0
+    assert out["p99_after_ms"] == 120.0
+    assert out["faults_injected"] == {"shard.handoff:drop": 2}
+    # Full audits ride under split/merge.
+    assert out["split"]["direction"] == "split"
+    assert out["split"]["per_group_committed"] == [60, 60, 60, 60]
+    assert out["merge"]["direction"] == "merge"
+
+    # Either run failing the audit flips the headline verdict — the
+    # section reports the miss, it does not hide it.
+    monkeypatch.setattr(
+        loadtest, "run_reshard_loadtest",
+        lambda **kw: _fake_reshard_result(
+            exactly_once=(kw.get("plan") == "reshard"),
+            reshard_completed_s=None))
+    bad = bench.bench_reshard(n_tx=200)
+    assert bad["exactly_once"] is False
+    assert bad["reshard_window_s"] is None  # never completed: honest null
+
+
+def test_validating_flagship_adaptive_ab_contract(monkeypatch):
+    """The flagship A/B contract: raft_validating_3node runs static-window
+    then adaptive-window coalescing, the section IS the armed run (flat
+    keys unchanged for trend tooling), and the static counterpart plus the
+    arming verdict ride under adaptive_coalesce_ab."""
+    calls = []
+
+    def fake_cluster(**kw):
+        calls.append(kw)
+        adaptive = kw.get("adaptive_coalesce")
+        return {"tx_per_sec": 44.0 if adaptive else 40.0, "p50_ms": 90.0,
+                "p99_ms": 250.0 if adaptive else 260.0,
+                "loadtest_sigs_per_sec": 700.0,
+                "sidecar": {"batches": 3}}
+
+    monkeypatch.setattr(bench, "bench_raft_cluster", fake_cluster)
+    out = bench.bench_validating_flagship(verifier="jax",
+                                          notary_device="accelerator")
+
+    json.dumps(out)
+    # Both runs happened, static first, on the flagship topology.
+    assert [kw["adaptive_coalesce"] for kw in calls] == [False, True]
+    assert all(kw["notary"] == "raft-validating" and kw["sidecar"]
+               for kw in calls)
+    assert all(kw["notary_device"] == "accelerator" for kw in calls)
+    # The section IS the armed run; the A/B rides alongside.
+    assert out["tx_per_sec"] == 44.0
+    ab = out["adaptive_coalesce_ab"]
+    assert ab["static"]["tx_per_sec"] == 40.0
+    assert ab["adaptive"]["tx_per_sec"] == 44.0
+    assert ab["tx_per_sec_ratio"] == 1.1
+    assert ab["p99_ratio"] == round(250.0 / 260.0, 3)
+    assert ab["adaptive_no_worse"] is True
+
+    # Adaptive tanking throughput flips the arming verdict.
+    monkeypatch.setattr(
+        bench, "bench_raft_cluster",
+        lambda **kw: {"tx_per_sec": 20.0 if kw.get("adaptive_coalesce")
+                      else 40.0, "p50_ms": 90.0, "p99_ms": 260.0,
+                      "loadtest_sigs_per_sec": 1.0, "sidecar": None})
+    bad = bench.bench_validating_flagship()
+    assert bad["adaptive_coalesce_ab"]["adaptive_no_worse"] is False
 
 
 def test_verifier_stamp_reports_device_occupancy():

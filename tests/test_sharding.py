@@ -30,19 +30,28 @@ from corda_tpu.node.services.api import UniquenessConflict, UniquenessException
 from corda_tpu.node.services.persistence import NodeDatabase
 from corda_tpu.node.services.raft import (
     BUSY,
+    WRONG_EPOCH,
     AbortReservedCommand,
     CommitReservedCommand,
+    InstallShardStateCommand,
     PutAllCommand,
     ReserveCommand,
+    ShardFenceCommand,
+    WrongShardEpochException,
     make_apply_command,
 )
 from corda_tpu.node.services.sharding import (
     ShardedUniquenessProvider,
+    parse_reshard_plan,
     parse_shard_service,
+    parse_shard_service_full,
+    publish_reshard_plan,
+    reshard_plan_string,
     shard_of,
     shard_service_string,
     split_by_shard,
 )
+from corda_tpu.serialization.codec import deserialize, serialize
 
 
 def _ref(tag: str, index: int = 0) -> StateRef:
@@ -503,6 +512,212 @@ def test_crashed_coordinator_reservation_released_by_ttl(tmp_path):
         assert time.monotonic() - t0 >= 0.5  # it actually waited the hold out
         assert _held() == 0
         assert nodes[1].uniqueness_provider.committed_count == 1
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+# -- elastic resharding (round 13) -------------------------------------------
+
+
+def test_epoch_service_strings_and_reshard_plan_parse():
+    # Epoch 0 emits the BARE pre-reshard format (old clients keep parsing).
+    assert shard_service_string(2, 4) == "corda.notary.shard.2of4"
+    assert parse_shard_service_full(shard_service_string(2, 4)) == (2, 4, 0)
+    assert parse_shard_service_full(shard_service_string(2, 4, epoch=3)) \
+        == (2, 4, 3)
+    # The 2-tuple parser stays epoch-blind for its existing callers.
+    assert parse_shard_service(shard_service_string(2, 4, epoch=3)) == (2, 4)
+    assert parse_shard_service_full("corda.notary.shard.2of4@x") is None
+    assert parse_reshard_plan(reshard_plan_string(1, 2, 4)) == (1, 2, 4)
+    assert parse_reshard_plan(reshard_plan_string(2, 4, 2)) == (2, 4, 2)
+    for bad in ("corda.notary.reshard.0:2to4",   # epoch must be >= 1
+                "corda.notary.reshard.1:2to3",   # not a double/halve
+                "corda.notary.reshard.1:2to",
+                "corda.notary.shard.1of2"):
+        assert parse_reshard_plan(bad) is None, bad
+
+
+def test_seal_fences_only_the_moving_keyspace(tmp_path):
+    """mode="seal" on the source of a 1 -> 2 split: refs moving to the new
+    group bounce WRONG_EPOCH (retryable after a directory re-derive), refs
+    the group keeps commit straight through — the unmoved majority sees no
+    outage. Abort stays exempt so 2PC unwinds never wedge on a fence."""
+    apply, db = _mk(tmp_path)
+    kept = _ref_in_group(0, count=2, salt="seal-keep")
+    moved = _ref_in_group(1, count=2, salt="seal-move")
+    assert apply(PutAllCommand((moved,), TX_A, CALLER, b"p0",
+                               issued_at=T0)) is None
+    assert apply(ShardFenceCommand(0, 1, 2, 1, "seal", b"f1")) is None
+    moved2 = _ref_in_group(1, count=2, salt="seal-move-2")
+    assert apply(PutAllCommand((moved2,), TX_B, CALLER, b"p1",
+                               issued_at=T0 + 1)) is WRONG_EPOCH
+    assert apply(ReserveCommand((moved2,), TX_B, CALLER, b"r1",
+                                issued_at=T0 + 1, ttl_s=5.0)) is WRONG_EPOCH
+    assert apply(PutAllCommand((kept,), TX_B, CALLER, b"p2",
+                               issued_at=T0 + 1)) is None
+    # Abort is NEVER fenced: releasing holds must work mid-handoff.
+    assert apply(AbortReservedCommand((moved2,), TX_B, b"a1")) is None
+    # Seal is idempotent (coordinator retry / log replay).
+    assert apply(ShardFenceCommand(0, 1, 2, 1, "seal", b"f2")) is None
+
+
+def test_handoff_install_activate_and_purge(tmp_path):
+    """The full two-phase state handoff at the apply layer: seal the
+    source, stream the moved slice, fence-then-activate the target, purge
+    the source. Exactly-once is structural — the moved spend stays final
+    on the new owner (with its consuming-tx provenance), and the sum of
+    per-group rows never double-counts."""
+    for d in ("src", "dst"):
+        (tmp_path / d).mkdir()
+    s_apply, s_db = _mk(tmp_path / "src")
+    t_apply, t_db = _mk(tmp_path / "dst")
+    kept = _ref_in_group(0, count=2, salt="ho-keep")
+    moved = _ref_in_group(1, count=2, salt="ho-move")
+    assert s_apply(PutAllCommand((kept, moved), TX_A, CALLER, b"p0",
+                                 issued_at=T0)) is None
+    assert s_apply(ShardFenceCommand(0, 1, 2, 1, "seal", b"f0")) is None
+    rows = s_db.conn.execute(
+        "SELECT state_ref, consuming FROM committed_states").fetchall()
+    moved_rows = tuple(
+        (bytes(b), bytes(c)) for b, c in rows
+        if shard_of(deserialize(bytes(b)), 2) == 1)
+    assert len(moved_rows) == 1
+    assert t_apply(InstallShardStateCommand(
+        moved_rows, (), 1, 1, 2, 1, b"i0")) is None
+    # First frame fenced the target "importing": a new-epoch client racing
+    # ahead of the cutover bounces instead of committing against a
+    # half-installed ledger.
+    assert t_apply(PutAllCommand((moved,), TX_B, CALLER, b"p1",
+                                 issued_at=T0 + 1)) is WRONG_EPOCH
+    # Re-install is idempotent (retried frame / log replay).
+    assert t_apply(InstallShardStateCommand(
+        moved_rows, (), 1, 1, 2, 1, b"i1")) is None
+    assert _committed(t_db) == 1
+    assert t_apply(ShardFenceCommand(1, 1, 2, 1, "activate", b"f1")) is None
+    # Final for a thief — the streamed row carries its consuming tx...
+    out = t_apply(PutAllCommand((moved,), TX_B, CALLER, b"p2",
+                                issued_at=T0 + 2))
+    assert isinstance(out, UniquenessConflict)
+    # ...and idempotent for the committing tx (retries converge).
+    assert t_apply(PutAllCommand((moved,), TX_A, CALLER, b"p3",
+                                 issued_at=T0 + 2)) is None
+    # The target only serves the keyspace it owns at the new count.
+    assert t_apply(PutAllCommand((kept,), TX_B, CALLER, b"p4",
+                                 issued_at=T0 + 2)) is WRONG_EPOCH
+    # Source activation purges the moved rows (the target's quorum owns
+    # them durably by now) and keeps the rest — the cross-group row sum
+    # stays exactly the consumed refs.
+    assert s_apply(ShardFenceCommand(0, 1, 2, 1, "activate", b"f2")) is None
+    assert _committed(s_db) == 1
+    (left,) = s_db.conn.execute(
+        "SELECT state_ref FROM committed_states").fetchone()
+    assert shard_of(deserialize(bytes(left)), 2) == 0
+    assert s_apply(PutAllCommand((moved,), TX_B, CALLER, b"p5",
+                                 issued_at=T0 + 3)) is WRONG_EPOCH
+
+
+def test_streamed_reservation_releases_by_original_ttl(tmp_path):
+    """Crashed-handoff-coordinator backstop: a 2PC hold streamed
+    mid-handoff keeps its ORIGINAL coordinator-stamped expires_at on the
+    new owner, so even if both the 2PC and the handoff coordinator die
+    forever, the hold releases by the same deterministic TTL arithmetic —
+    on a group that never saw the original reserve."""
+    t_apply, t_db = _mk(tmp_path)
+    held = _ref_in_group(1, count=2, salt="ttl-stream")
+    assert t_apply(InstallShardStateCommand(
+        (), ((serialize(held).bytes, TX_A.bytes, T0 + 5.0),),
+        1, 1, 2, 1, b"i0")) is None
+    assert t_apply(ShardFenceCommand(1, 1, 2, 1, "activate", b"f0")) is None
+    assert _reserved(t_db) == 1
+    # Inside the hold: enforced on the new owner exactly as on the old.
+    assert t_apply(PutAllCommand((held,), TX_B, CALLER, b"p0",
+                                 issued_at=T0 + 4.9)) is BUSY
+    # Stamped at/past the original expiry: the deterministic steal.
+    assert t_apply(PutAllCommand((held,), TX_B, CALLER, b"p1",
+                                 issued_at=T0 + 5.0)) is None
+    assert (_reserved(t_db), _committed(t_db)) == (0, 1)
+
+
+def test_live_split_old_epoch_bounce_rederive_exactly_once(tmp_path):
+    """The tentpole end to end, deterministically: a 1 -> 2 split over two
+    in-process nodes (group 1 booted as a PENDING target). An old-epoch
+    submission hits the sealed source and surfaces WrongShardEpochException
+    — resubmitting to the same group can never succeed — then the
+    plan-driven handoff runs to completion through the node loop, routing
+    re-derives, and the SAME transactions converge exactly once with the
+    moved history answering on the new owner."""
+    import os as _os
+
+    cfg = ShardConfig(count=1, groups=(("ShardA",), ("ShardB",)),
+                      reserve_ttl_s=15.0)
+    nodes = []
+    for name in SHARD_NAMES:
+        nodes.append(Node(NodeConfig(
+            name=name, base_dir=tmp_path / name, notary="raft-simple",
+            raft_cluster=(name,), network_map=tmp_path / "netmap.json",
+            notary_shards=cfg)).start())
+    try:
+        for n in nodes:
+            n.refresh_netmap()
+        wait_group_leaders(nodes)
+        prov = nodes[0].uniqueness_provider
+        assert (prov.count, prov.epoch) == (1, 0)
+        moved = _ref_in_group(1, count=2, salt="live-move")
+        tx_m = SecureHash.sha256(b"live-moved-tx")
+        # Pre-split: EVERYTHING routes to group 0 (count=1 fast path).
+        assert drive(nodes, prov.commit_async(
+            (moved,), tx_m, nodes[0].identity)) is True
+        assert nodes[0].uniqueness_provider.committed_count == 1
+
+        # Seal group 0 by hand (the coordinator's first step) so the
+        # old-epoch bounce is deterministic, not a race with the stream.
+        nodes[0].raft_member.submit(
+            ShardFenceCommand(0, 1, 2, 1, "seal", _os.urandom(16)))
+
+        def _sealed():
+            f = prov._read_fence()
+            return True if f and f["mode"] == "sealed" else None
+
+        drive(nodes, _sealed)
+        moved2 = _ref_in_group(1, count=2, salt="live-move-2")
+        tx_2 = SecureHash.sha256(b"live-post-split-tx")
+        with pytest.raises(WrongShardEpochException):
+            drive(nodes, prov.commit_async(
+                (moved2,), tx_2, nodes[0].identity))
+        assert prov.metrics["wrong_epoch"] >= 1
+
+        # Publish the plan; the node loop picks it up off the netmap and
+        # the source leader re-runs seal -> stream -> activate (idempotent
+        # over the manual seal) to completion.
+        publish_reshard_plan(tmp_path / "netmap.json", 1, 1, 2,
+                             nodes[0].identity.owning_key)
+
+        def _adopted():
+            done = all(
+                n.uniqueness_provider.epoch >= 1
+                and n.uniqueness_provider.count == 2 for n in nodes)
+            return True if done else None
+
+        drive(nodes, _adopted, timeout=30.0)
+
+        # Re-derived routing: the bounced tx now lands on group 1 and
+        # commits; the pre-split spend is idempotent for its own tx and
+        # FINAL for a thief — served by the NEW owner from streamed state.
+        assert drive(nodes, prov.commit_async(
+            (moved2,), tx_2, nodes[0].identity)) is True
+        assert drive(nodes, prov.commit_async(
+            (moved,), tx_m, nodes[0].identity)) is True
+        with pytest.raises(UniquenessException):
+            drive(nodes, prov.commit_async(
+                (moved,), SecureHash.sha256(b"live-thief"),
+                nodes[0].identity))
+        # Exactly-once across the ledgers: each spend exactly one row, the
+        # moved history purged from the source.
+        assert nodes[0].uniqueness_provider.committed_count == 0
+        assert nodes[1].uniqueness_provider.committed_count == 2
+        assert prov.stamp()["epoch"] == 1
+        assert nodes[0].uniqueness_provider.metrics["resharded"] == 1
     finally:
         for n in nodes:
             n.stop()
