@@ -111,6 +111,25 @@ class QosConfig:
 
 
 @dataclass(frozen=True)
+class DurabilityConfig:
+    """Durability plane policy (node/services/integrity.py).
+
+    ``scrub_enabled = false`` (the default) leaves the online scrubber off —
+    write-path CRC framing is always on (one crc32c per insert), but
+    disarmed nodes spend nothing on background verification and behaviour
+    is otherwise bit-identical to the pre-durability tree. Boot fsck is a
+    separate tool (``python -m corda_tpu.tools.fsck``), not a config knob.
+    """
+
+    scrub_enabled: bool = False
+    # Scrubber row-rate ceiling: the pass sleeps so it never verifies more
+    # than this many rows per second (low-priority by construction).
+    scrub_rows_per_s: float = 500.0
+    # Idle wait between full-table scrub passes.
+    scrub_interval_s: float = 5.0
+
+
+@dataclass(frozen=True)
 class ShardConfig:
     """Sharded-notary topology (services/sharding.py).
 
@@ -146,6 +165,7 @@ class NodeConfig:
     batch: BatchConfig = field(default_factory=BatchConfig)
     raft: RaftConfig = field(default_factory=RaftConfig)
     qos: QosConfig = field(default_factory=QosConfig)
+    durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     # Sharded notary: when set (count > 1 or groups non-empty), this raft-*
     # notary member is one shard of a partitioned uniqueness service and
     # uses the ShardedUniquenessProvider two-phase coordinator.
@@ -171,8 +191,8 @@ class NodeConfig:
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
                  "network_map", "map_service", "map_node", "tls", "web_port",
-                 "verifier", "batch", "raft", "qos", "rpc_users", "cordapps",
-                 "notary_shards"}
+                 "verifier", "batch", "raft", "qos", "durability",
+                 "rpc_users", "cordapps", "notary_shards"}
         unknown = set(raw) - known
         if unknown:
             raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -188,6 +208,7 @@ class NodeConfig:
         batch = raw.get("batch", {})
         raft = raw.get("raft", {})
         qos = raw.get("qos", {})
+        durability = raw.get("durability", {})
         shards_raw = raw.get("notary_shards")
         shards = None
         if shards_raw is not None:
@@ -249,6 +270,13 @@ class NodeConfig:
                 bulk_rate=float(qos.get("bulk_rate", 0.0)),
                 bulk_burst=float(qos.get("bulk_burst", 32.0)),
                 queue_watermark=int(qos.get("queue_watermark", 0)),
+            ),
+            durability=DurabilityConfig(
+                scrub_enabled=bool(durability.get("scrub_enabled", False)),
+                scrub_rows_per_s=float(
+                    durability.get("scrub_rows_per_s", 500.0)),
+                scrub_interval_s=float(
+                    durability.get("scrub_interval_s", 5.0)),
             ),
             notary_shards=shards,
             rpc_users=tuple(

@@ -83,6 +83,19 @@ class Node:
                          bulk_every=config.qos.bulk_every)
         config.base_dir.mkdir(parents=True, exist_ok=True)
         self.db = NodeDatabase(config.base_dir / "node.db")
+        # Durability plane: the online scrubber is built here but only
+        # started in start() (a constructed-but-unstarted node must not
+        # carry a background thread). None when disarmed — every metrics
+        # touch point short-circuits on that one attribute check.
+        self.scrubber = None
+        if config.durability.scrub_enabled:
+            from .services.integrity import Scrubber
+
+            self.scrubber = Scrubber(
+                self.db.path,
+                rows_per_s=config.durability.scrub_rows_per_s,
+                interval_s=config.durability.scrub_interval_s,
+                node_name=config.name)
         self.key = self.db.load_or_create_identity(config.name)
         from ..crypto.party import Party
 
@@ -478,6 +491,8 @@ class Node:
         # restart exactly like the pre-warm-up boot did.
         self._warm_verifier_maybe()
         self.smm.start()
+        if self.scrubber is not None:
+            self.scrubber.start()
         self._started = True
         return self
 
@@ -766,6 +781,11 @@ class Node:
                 "interpreter exit may abort — exit this process via "
                 "process death, not finalization")
         self.messaging.stop()
+        if self.scrubber is not None:
+            # Before db.close(): the scrubber holds its own connection, but
+            # a pass racing teardown must wind down while the store is
+            # still guaranteed to exist.
+            self.scrubber.stop()
         self.db.close()
         if self._warm_thread is not None and self._warm_thread.is_alive():
             # An in-process (test/embedded) node must not carry a live
@@ -811,6 +831,25 @@ def main(argv: list[str] | None = None) -> int:
     # QoS plane: normally armed from [qos] in the config (Node.__init__);
     # CORDA_TPU_QOS arms it env-wise for ad-hoc runs. A no-op when unset.
     _qos.arm_from_env(config.name)
+    # Boot fsck: verify the store's integrity frames before serving.
+    # Log-only here — corruption found at boot is reported loudly and then
+    # handled by the online planes (raft heal / checkpoint quarantine);
+    # operators wanting a hard gate run `python -m corda_tpu.tools.fsck
+    # <base-dir> --repair` before start.
+    try:
+        from ..tools.fsck import fsck_paths
+
+        report = fsck_paths(config.base_dir)
+        if not report["clean"]:
+            logging.getLogger("corda_tpu.node").error(
+                "boot fsck: %d corrupt row(s) across %d store(s) — "
+                "self-healing will repair what consensus can; run "
+                "corda_tpu.tools.fsck --repair for the rest",
+                report["corrupt"], report["stores"])
+    except Exception:
+        # Never block boot on the checker itself (e.g. a locked store
+        # during a crash-restart race) — the online scrubber covers it.
+        logging.getLogger("corda_tpu.node").exception("boot fsck failed")
     node = Node(config).start()
     print(f"node {config.name} up at {node.messaging.my_address}", flush=True)
     # Attribution hook: CORDA_TPU_NODE_PROFILE=<dir> dumps a cProfile of

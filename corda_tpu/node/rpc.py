@@ -31,6 +31,7 @@ from ..qos import context as _qos
 from ..serialization.codec import deserialize, register, serialize
 from ..testing import faults as _faults
 from .messaging.api import Message, MessagingService, TopicSession
+from .services import integrity as _integrity
 
 # Codec-whitelist imports: every type that can cross the RPC boundary must be
 # REGISTERED in the client process too, and registration happens at module
@@ -247,6 +248,11 @@ class NodeRpcOps:
             # dropped span counts, or None while disarmed.
             "obs": (_obs.ACTIVE.stats()
                     if _obs.ACTIVE is not None else None),
+            # Durability plane stamps (services/integrity.py): process-wide
+            # quarantine/shed counters plus this node's online-scrubber
+            # scan/error counts when one is armed.
+            "durability": _integrity.stats(
+                getattr(self._node, "scrubber", None)),
             # QoS plane stamps (qos/context.py): per-lane flow counts,
             # anti-starvation picks, early flushes — plus the admission
             # controller's admitted/shed counters when one is attached to

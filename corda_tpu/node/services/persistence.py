@@ -23,8 +23,11 @@ from typing import Callable, Sequence
 from ...crypto.hashes import SecureHash
 from ...crypto.keys import KeyPair
 from ...crypto.party import Party
+from ...obs import trace as _obs
 from ...serialization.codec import deserialize, serialize
+from ...testing import faults as _faults
 from ..statemachine import CheckpointStorage
+from . import integrity as _integrity
 from .api import (
     AttachmentStorage,
     ConsumingTx,
@@ -117,6 +120,10 @@ class NodeDatabase:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(self._SCHEMA)
+        # Durability plane: add the nullable crc column to integrity-framed
+        # tables (in-place upgrade — legacy rows keep crc NULL until the
+        # scrubber backfills) and create the quarantine table.
+        _integrity.ensure_integrity_schema(self._conn)
         self._conn.commit()
         self._batch_depth = 0  # node-thread round batching (see batch())
         self._batch_thread: int | None = None  # owning thread id
@@ -256,8 +263,10 @@ class DBCheckpointStorage(CheckpointStorage):
 
     def update_checkpoint(self, run_id: bytes, blob: bytes) -> None:
         self._db.conn.execute(
-            "INSERT OR REPLACE INTO checkpoints (run_id, blob) VALUES (?, ?)",
-            (run_id, blob))
+            "INSERT OR REPLACE INTO checkpoints (run_id, blob, crc) "
+            "VALUES (?, ?, ?)",
+            (run_id, blob,
+             _integrity.checkpoint_crc(bytes(run_id).hex(), blob)))
         self._db.commit()
 
     def remove_checkpoint(self, run_id: bytes) -> None:
@@ -266,8 +275,45 @@ class DBCheckpointStorage(CheckpointStorage):
         self._db.commit()
 
     def checkpoints(self) -> list[bytes]:
-        return [bytes(b) for (b,) in self._db.conn.execute(
-            "SELECT blob FROM checkpoints ORDER BY run_id")]
+        return [blob for _rid, blob in self.items()]
+
+    def items(self) -> list[tuple[bytes, bytes]]:
+        """(run_id, blob) pairs, checksum-verified: a corrupt row is
+        quarantined HERE — before it can poison the SMM replay loop — and
+        its flow restores as failed-by-absence (the run id is simply not
+        in the returned set). Legacy rows (crc NULL) pass through
+        unverified until the scrubber backfills them."""
+        with self._db.lock:
+            rows = self._db.conn.execute(
+                "SELECT run_id, blob, crc FROM checkpoints ORDER BY run_id"
+            ).fetchall()
+        out = []
+        for run_id, blob, crc in rows:
+            run_id, blob = bytes(run_id), bytes(blob)
+            if _faults.ACTIVE is not None:
+                blob = _faults.fire_disk_corrupt(blob)
+            if crc is not None and _integrity.checkpoint_crc(
+                    run_id.hex(), blob) != int(crc):
+                self.quarantine(run_id, blob, "checkpoint crc mismatch")
+                continue
+            out.append((run_id, blob))
+        return out
+
+    def quarantine(self, run_id: bytes, blob: bytes, reason: str) -> None:
+        """Move one corrupt/undecodable checkpoint into the quarantine
+        table (counted, never silently dropped) so restore can proceed
+        without it."""
+        t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
+        with self._db.lock:
+            _integrity.quarantine_row(
+                self._db.conn, "checkpoint", bytes(run_id), blob, reason)
+            self._db.conn.execute(
+                "DELETE FROM checkpoints WHERE run_id = ?", (bytes(run_id),))
+            self._db.commit()
+        _integrity.bump("checkpoints_quarantined")
+        if _obs.ACTIVE is not None:
+            _obs.record("repair", t0, _obs.now(),
+                        attrs={"kind": "checkpoint", "reason": reason})
 
     def __len__(self):
         (n,) = self._db.conn.execute(
@@ -396,6 +442,8 @@ class PersistentUniquenessProvider(UniquenessProvider):
 
     def commit(self, states: Sequence, tx_id: SecureHash,
                caller_identity: Party) -> None:
+        if _faults.ACTIVE is not None:
+            _faults.fire_disk_full()  # disk.full: sheds at the notarise path
         with self._db.lock:  # check-then-insert must be atomic vs other threads
             conn = self._db.conn
             conflicts = {}
@@ -409,13 +457,31 @@ class PersistentUniquenessProvider(UniquenessProvider):
                         conflicts[ref] = consuming
             if conflicts:
                 raise UniquenessException(UniquenessConflict(dict(conflicts)))
-            for i, ref in enumerate(states):
-                conn.execute(
-                    "INSERT OR IGNORE INTO committed_states (state_ref, consuming) "
-                    "VALUES (?, ?)",
-                    (serialize(ref).bytes,
-                     serialize(ConsumingTx(tx_id, i, caller_identity)).bytes))
-            self._db.commit()
+            inserted: list[bytes] = []
+            try:
+                for i, ref in enumerate(states):
+                    ref_blob = serialize(ref).bytes
+                    consuming_blob = serialize(
+                        ConsumingTx(tx_id, i, caller_identity)).bytes
+                    before = conn.total_changes
+                    conn.execute(
+                        "INSERT OR IGNORE INTO committed_states "
+                        "(state_ref, consuming, crc) VALUES (?, ?, ?)",
+                        (ref_blob, consuming_blob,
+                         _integrity.committed_crc(ref_blob, consuming_blob)))
+                    if conn.total_changes > before:
+                        inserted.append(ref_blob)
+                self._db.commit()
+            except sqlite3.OperationalError:
+                # Disk exhausted mid-claim: the all-or-nothing contract
+                # must hold even inside a round batch (where rollback would
+                # discard unrelated writes) — compensate by deleting only
+                # the rows THIS call inserted, then let the caller shed.
+                for ref_blob in inserted:
+                    conn.execute(
+                        "DELETE FROM committed_states WHERE state_ref = ?",
+                        (ref_blob,))
+                raise
 
     @property
     def committed_count(self) -> int:

@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import sqlite3
 import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
@@ -49,6 +50,7 @@ from ...obs import trace as _obs
 from ...qos import context as _qos
 from ...serialization.codec import deserialize, register, serialize
 from ...testing import faults as _faults
+from . import integrity as _integrity
 from ..messaging.api import MessagingService, TopicSession
 from .api import (
     ConsumingTx,
@@ -64,7 +66,8 @@ _RAFT_SCHEMA = """
 CREATE TABLE IF NOT EXISTS raft_log (
     idx  INTEGER PRIMARY KEY,
     term INTEGER NOT NULL,
-    blob BLOB NOT NULL
+    blob BLOB NOT NULL,
+    crc  INTEGER
 );
 CREATE TABLE IF NOT EXISTS raft_meta (
     singleton INTEGER PRIMARY KEY CHECK (singleton = 1),
@@ -74,7 +77,8 @@ CREATE TABLE IF NOT EXISTS raft_meta (
 CREATE TABLE IF NOT EXISTS reserved_states (
     state_ref  BLOB PRIMARY KEY,
     tx_id      BLOB NOT NULL,
-    expires_at REAL NOT NULL
+    expires_at REAL NOT NULL,
+    crc        INTEGER
 );
 """
 
@@ -349,6 +353,10 @@ class InstallSnapshot:
     offset: int = 0
     done: bool = True
     reservations: tuple = ()  # ((state_ref_blob, tx_id_bytes, expires_at),)
+    # CRC32C over this chunk's entry blobs (durability plane): a follower
+    # discards a damaged chunk instead of installing it; 0 = unverified
+    # (frames from pre-durability senders decode with the default).
+    crc: int = 0
 
 
 @register
@@ -357,6 +365,15 @@ class InstallSnapshotReply:
     term: int
     follower: str
     last_included_index: int
+
+
+def _snapshot_chunk_crc(entries) -> int:
+    """Running CRC32C over an InstallSnapshot chunk's entry blobs, in
+    order — binds both content and sequence of the (ref, consuming) pairs."""
+    c = 0
+    for ref, consuming in entries:
+        c = _integrity.crc32c(bytes(consuming), _integrity.crc32c(bytes(ref), c))
+    return c
 
 
 class _Busy:
@@ -431,6 +448,10 @@ class RaftMember:
 
         with db.lock:
             db.conn.executescript(_RAFT_SCHEMA)
+            # Legacy databases created before the durability plane get the
+            # nullable crc column added in place (IF NOT EXISTS above only
+            # covers fresh files).
+            _integrity.ensure_integrity_schema(db.conn)
             row = db.conn.execute(
                 "SELECT term, voted_for FROM raft_meta WHERE singleton=1"
             ).fetchone()
@@ -521,6 +542,12 @@ class RaftMember:
             "replication_rtt_s": 0.0,  # broadcast -> quorum commit, summed
             "replication_rtt_n": 0,
             "qos_early_seals": 0,   # rounds sealed early for a deadline
+            # Durability plane (integrity.py): corrupt rows detected on the
+            # log read paths, repairs taken, and disk-exhaustion degrades.
+            "integrity_errors": 0,  # crc mismatches detected
+            "log_truncations": 0,   # corrupt-suffix heals (truncate/compact)
+            "leader_stepdowns": 0,  # leaderships ceded to corruption/disk
+            "disk_degraded": 0,     # disk-full write failures absorbed
         }
         messaging.add_message_handler(RAFT_TOPIC, 0, self._on_message)
 
@@ -555,6 +582,7 @@ class RaftMember:
     def _log_append(self, idx: int, term: int, command) -> None:
         if _faults.ACTIVE is not None:
             _faults.fire_fsync("raft.fsync")
+            _faults.fire_disk_full()
         # Traced only on the leader's seal path (_obs_members set): the
         # serialize+insert is the raft_append span, the db.commit (sqlite's
         # fsync point outside batched rounds) is the fsync span.
@@ -563,8 +591,9 @@ class RaftMember:
         blob = serialize(command).bytes
         with self.db.lock:
             self.db.conn.execute(
-                "INSERT OR REPLACE INTO raft_log (idx, term, blob) "
-                "VALUES (?, ?, ?)", (idx, term, blob))
+                "INSERT OR REPLACE INTO raft_log (idx, term, blob, crc) "
+                "VALUES (?, ?, ?, ?)",
+                (idx, term, blob, _integrity.log_crc(idx, term, blob)))
             t1 = _obs.now() if traced else 0.0
             self.db.commit()
         if traced:
@@ -577,14 +606,18 @@ class RaftMember:
     def _log_append_blob(self, idx: int, term: int, blob: bytes) -> None:
         """Follower-side append of a pre-encoded entry: the wire blob goes
         into raft_log verbatim (no decode on the replication hot path);
-        deserialization happens lazily at apply time."""
+        deserialization happens lazily at apply time. The crc rides a
+        separate column, so the stored blob stays byte-identical to the
+        leader's."""
         if _faults.ACTIVE is not None:
             _faults.fire_fsync("raft.fsync")
+            _faults.fire_disk_full()
         blob = bytes(blob)
         with self.db.lock:
             self.db.conn.execute(
-                "INSERT OR REPLACE INTO raft_log (idx, term, blob) "
-                "VALUES (?, ?, ?)", (idx, term, blob))
+                "INSERT OR REPLACE INTO raft_log (idx, term, blob, crc) "
+                "VALUES (?, ?, ?, ?)",
+                (idx, term, blob, _integrity.log_crc(idx, term, blob)))
             self.db.commit()
         self._entry_cache.pop(idx, None)
         self._blob_cache[idx] = (term, blob)
@@ -597,6 +630,29 @@ class RaftMember:
             for i in [i for i in cache if i >= idx]:
                 del cache[i]
 
+    def _verified_log_rows(self, idx: int, limit: int):
+        """sqlite read path shared by _log_entries_from/_log_blobs_from:
+        fetch rows, apply the seeded ``disk.corrupt`` fault (bit-flips on
+        READ bytes — stored bytes stay intact, so repair genuinely
+        recovers), and verify each row's crc frame. The first corrupt row
+        triggers :meth:`_heal_corrupt_entry` and ends the batch — callers
+        get the verified prefix, the healed member re-fetches the rest
+        through normal replication."""
+        rows = self.db.conn.execute(
+            "SELECT idx, term, blob, crc FROM raft_log WHERE idx >= ? "
+            "ORDER BY idx LIMIT ?", (idx, limit)).fetchall()
+        out = []
+        for r in rows:
+            row_idx, row_term, blob = r[0], r[1], bytes(r[2])
+            if _faults.ACTIVE is not None:
+                blob = _faults.fire_disk_corrupt(blob)
+            if r[3] is not None and \
+                    _integrity.log_crc(row_idx, row_term, blob) != int(r[3]):
+                self._heal_corrupt_entry(row_idx)
+                break
+            out.append((row_idx, row_term, blob))
+        return out
+
     def _log_entries_from(self, idx: int, limit: int = 256):
         # Serve from the in-memory mirror when it covers the whole span.
         last_idx, _ = self._log_last()
@@ -605,13 +661,10 @@ class RaftMember:
         span = range(idx, min(last_idx, idx + limit - 1) + 1)
         if all(i in self._entry_cache for i in span):
             return [(i, *self._entry_cache[i]) for i in span]
-        rows = self.db.conn.execute(
-            "SELECT idx, term, blob FROM raft_log WHERE idx >= ? "
-            "ORDER BY idx LIMIT ?", (idx, limit)).fetchall()
         out = []
-        for r in rows:
-            entry = (r[0], r[1], deserialize(bytes(r[2])))
-            self._entry_cache[r[0]] = (entry[1], entry[2])
+        for row_idx, row_term, blob in self._verified_log_rows(idx, limit):
+            entry = (row_idx, row_term, deserialize(blob))
+            self._entry_cache[row_idx] = (entry[1], entry[2])
             out.append(entry)
         return out
 
@@ -625,15 +678,73 @@ class RaftMember:
         span = range(idx, min(last_idx, idx + limit - 1) + 1)
         if all(i in self._blob_cache for i in span):
             return [(i, *self._blob_cache[i]) for i in span]
-        rows = self.db.conn.execute(
-            "SELECT idx, term, blob FROM raft_log WHERE idx >= ? "
-            "ORDER BY idx LIMIT ?", (idx, limit)).fetchall()
         out = []
-        for r in rows:
-            entry = (r[0], r[1], bytes(r[2]))
-            self._blob_cache[r[0]] = (entry[1], entry[2])
+        for entry in self._verified_log_rows(idx, limit):
+            self._blob_cache[entry[0]] = (entry[1], entry[2])
             out.append(entry)
         return out
+
+    def _heal_corrupt_entry(self, idx: int) -> None:
+        """Self-healing for a corrupt log row detected at *idx*: corruption
+        becomes a LAGGING member, never a diverged one.
+
+        * ``idx > last_applied`` — the damaged entry's effects are not yet
+          in the state machine: truncate the log from idx (the last
+          verified prefix survives), clamp commit_index to what remains,
+          and let next_index backoff / InstallSnapshot re-replicate.
+        * ``idx <= last_applied`` — the effects are durable in
+          committed_states: compact the applied prefix behind a snapshot
+          marker (same ONE-transaction invariant as maybe_compact), which
+          drops the damaged row legitimately.
+
+        A leader additionally steps down: its log can no longer vouch for
+        the range it was replicating (the corrupt-unreplicated-suffix
+        case), and a healthy majority elects around it."""
+        self.metrics["integrity_errors"] += 1
+        self.metrics["log_truncations"] += 1
+        t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
+        was_leader = self.role == "leader"
+        with self.db.lock:
+            try:
+                if idx <= self.last_applied:
+                    upto = self.last_applied
+                    term = self._log_term_at(upto)
+                    if term is None:
+                        term = self.snapshot_term
+                    self.db.conn.execute(
+                        "DELETE FROM raft_log WHERE idx <= ?", (upto,))
+                    for key, value in (("raft_snapshot_index", str(upto)),
+                                       ("raft_snapshot_term", str(term))):
+                        self.db.conn.execute(
+                            "INSERT OR REPLACE INTO settings (key, value) "
+                            "VALUES (?, ?)", (key, value))
+                    self.db.commit()
+                    self.snapshot_index, self.snapshot_term = upto, int(term)
+                    evict = lambda i: i <= upto  # noqa: E731
+                else:
+                    self.db.conn.execute(
+                        "DELETE FROM raft_log WHERE idx >= ?", (idx,))
+                    self.commit_index = min(self.commit_index, idx - 1)
+                    self.db.conn.execute(
+                        "INSERT OR REPLACE INTO settings (key, value) "
+                        "VALUES (?, ?)",
+                        ("raft_commit_index", str(self.commit_index)))
+                    self.db.commit()
+                    evict = lambda i: i >= idx  # noqa: E731
+            except BaseException:
+                if not self.db.in_batch:
+                    self.db.conn.rollback()
+                raise
+        for cache in (self._entry_cache, self._blob_cache):
+            for i in [i for i in cache if evict(i)]:
+                del cache[i]
+        if _obs.ACTIVE is not None:
+            _obs.record("repair", t0, _obs.now(),
+                        attrs={"kind": "raft_log", "idx": idx,
+                               "node": self.name})
+        if was_leader:
+            self.metrics["leader_stepdowns"] += 1
+            self._become_follower(self.term)
 
     # -- timers (driven from the node's run loop) --------------------------
 
@@ -704,6 +815,18 @@ class RaftMember:
                 self.metrics["group_commits"] += 1
                 self.metrics["group_commands"] += len(cmds)
                 self._log_append(last_idx + 1, self.term, PutAllBatch(cmds))
+        except sqlite3.OperationalError as e:
+            if not _integrity.is_disk_full(e):
+                raise
+            # Graceful disk exhaustion: a leader that cannot extend its log
+            # must stop leading, not crash the process. The round's commands
+            # were never sealed — restore them so _depose bounces each with
+            # a retryable reply, and cede leadership to a member that can
+            # still write.
+            self.metrics["disk_degraded"] += 1
+            self._trace_members.pop(last_idx + 1, None)
+            self._pending_batch = list(cmds)
+            self._become_follower(self.term)
         finally:
             self._obs_members = None
 
@@ -997,6 +1120,10 @@ class RaftMember:
     SNAPSHOT_CHUNK = 10_000  # map entries per InstallSnapshot frame
 
     def _broadcast_append(self) -> None:
+        if self.role != "leader":
+            # A disk-full degrade or corruption heal inside this round's
+            # seal/read path stepped us down: nothing to broadcast.
+            return
         self._last_heartbeat = now = self.clock()
         for peer_name, addr in self.peers.items():
             nxt = self._next_index.get(peer_name, 1)
@@ -1031,7 +1158,8 @@ class RaftMember:
                         chunks.append(serialize(InstallSnapshot(
                             self.term, self.name, self.snapshot_index,
                             self.snapshot_term, chunk, off, done,
-                            reservations if done else ())).bytes)
+                            reservations if done else (),
+                            crc=_snapshot_chunk_crc(chunk))).bytes)
                     # The whole ordered series hits the durable outbox as
                     # one burst (one executemany/fsync, one bridge wakeup).
                     self._send_burst(addr, chunks)
@@ -1052,6 +1180,8 @@ class RaftMember:
             room = min(self.config.append_chunk,
                        self.config.pipeline_window - (sent - (nxt - 1)))
             blobs = self._log_blobs_from(sent + 1, limit=room)
+            if self.role != "leader":
+                return  # a corrupt row in the read span healed + stepped down
             if blobs:
                 prev_idx = sent
                 entries = tuple((term, blob) for _i, term, blob in blobs)
@@ -1121,14 +1251,23 @@ class RaftMember:
             # Log prefix deletion and the snapshot marker must be ONE
             # transaction: a crash between them would leave a log whose
             # indices silently rebase to 1 — replicated-log corruption.
-            self.db.conn.execute(
-                "DELETE FROM raft_log WHERE idx <= ?", (upto,))
-            for key, value in (("raft_snapshot_index", str(upto)),
-                               ("raft_snapshot_term", str(term))):
+            try:
                 self.db.conn.execute(
-                    "INSERT OR REPLACE INTO settings (key, value) "
-                    "VALUES (?, ?)", (key, value))
-            self.db.commit()
+                    "DELETE FROM raft_log WHERE idx <= ?", (upto,))
+                for key, value in (("raft_snapshot_index", str(upto)),
+                                   ("raft_snapshot_term", str(term))):
+                    self.db.conn.execute(
+                        "INSERT OR REPLACE INTO settings (key, value) "
+                        "VALUES (?, ?)", (key, value))
+                self.db.commit()
+            except BaseException:
+                # A failure between the DELETE and the marker write must not
+                # leave the half-compacted prefix in the open transaction —
+                # a later unrelated commit would persist it WITHOUT the
+                # marker, silently rebasing log indices.
+                if not self.db.in_batch:
+                    self.db.conn.rollback()
+                raise
         for cache in (self._entry_cache, self._blob_cache):
             for i in [i for i in cache if i <= upto]:
                 del cache[i]
@@ -1141,6 +1280,12 @@ class RaftMember:
             self._send(sender, InstallSnapshotReply(self.term, self.name, 0))
             return
         self._become_follower(snap.term, leader=snap.leader)
+        if snap.crc and _snapshot_chunk_crc(snap.entries) != snap.crc:
+            # Damaged chunk: drop the whole staged series rather than
+            # install bad ledger rows — the leader re-sends on its throttle.
+            self.metrics["integrity_errors"] += 1
+            self._snapshot_staging = None
+            return
         # Chunk assembly: chunks of one snapshot series arrive in order on
         # the same bridge; offset 0 restarts staging, mismatched continuation
         # discards (the leader re-sends the series on its throttle).
@@ -1165,13 +1310,18 @@ class RaftMember:
                 self.db.conn.execute("DELETE FROM committed_states")
                 self.db.conn.executemany(
                     "INSERT OR REPLACE INTO committed_states "
-                    "(state_ref, consuming) VALUES (?, ?)",
-                    list(entries))
+                    "(state_ref, consuming, crc) VALUES (?, ?, ?)",
+                    [(ref, con, _integrity.committed_crc(
+                        bytes(ref), bytes(con)))
+                     for ref, con in entries])
                 self.db.conn.execute("DELETE FROM reserved_states")
                 self.db.conn.executemany(
                     "INSERT OR REPLACE INTO reserved_states "
-                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
-                    [(bytes(ref), bytes(tx), float(exp))
+                    "(state_ref, tx_id, expires_at, crc) "
+                    "VALUES (?, ?, ?, ?)",
+                    [(bytes(ref), bytes(tx), float(exp),
+                      _integrity.reserved_crc(
+                          bytes(ref), bytes(tx), float(exp)))
                      for ref, tx, exp in snap.reservations])
                 self._entry_cache.clear()
                 self._blob_cache.clear()
@@ -1207,16 +1357,29 @@ class RaftMember:
                 hint_index=self._log_last()[0]))
             return
         idx = ae.prev_index
-        for term, blob in ae.entries:
-            idx += 1
-            existing = self._log_term_at(idx)
-            if existing is not None and existing != term:
-                self._log_truncate_from(idx)
-                existing = None
-            if existing is None:
-                # The wire carries the leader's encoded blob: insert it
-                # verbatim (no decode on the replication hot path).
-                self._log_append_blob(idx, term, blob)
+        try:
+            for term, blob in ae.entries:
+                idx += 1
+                existing = self._log_term_at(idx)
+                if existing is not None and existing != term:
+                    self._log_truncate_from(idx)
+                    existing = None
+                if existing is None:
+                    # The wire carries the leader's encoded blob: insert it
+                    # verbatim (no decode on the replication hot path).
+                    self._log_append_blob(idx, term, blob)
+        except sqlite3.OperationalError as e:
+            if not _integrity.is_disk_full(e):
+                raise
+            # Graceful disk exhaustion on the follower append path: the
+            # entries landed up to a verified prefix; reply failure with an
+            # honest hint so the leader rewinds and retries later, instead
+            # of crashing the member out of the quorum.
+            self.metrics["disk_degraded"] += 1
+            self._send(sender, AppendReply(
+                self.term, False, 0, self.name,
+                hint_index=self._log_last()[0]))
+            return
         if ae.leader_commit > self.commit_index:
             # Raft §5.3: commit only up to the VERIFIED prefix — the index of
             # the last entry THIS append confirmed (prev + entries) — never
@@ -1332,11 +1495,16 @@ class RaftMember:
         # ONE multi-outcome frame per destination for the whole apply pass.
         outbound: dict[str, list[ClientReply]] = {}
         while self.last_applied < self.commit_index:
+            # Read FIRST, advance after: if the next entry is missing (raced
+            # compaction) or corrupt (heal truncated it out from under us),
+            # last_applied must still name the last entry whose effects are
+            # durably in committed_states — the heal path's "idx <=
+            # last_applied" compact-vs-truncate decision depends on it.
+            entries = self._log_entries_from(self.last_applied + 1, limit=1)
+            if not entries or entries[0][0] != self.last_applied + 1:
+                break
             self.last_applied += 1
             applied_any = True
-            entries = self._log_entries_from(self.last_applied, limit=1)
-            if not entries:
-                break
             _idx, _term, entry = entries[0]
             commands = (entry.commands if isinstance(entry, PutAllBatch)
                         else (entry,) if entry is not None else ())
@@ -1429,6 +1597,13 @@ class RaftMember:
             # QoS plane: scheduling rounds sealed early because a buffered
             # interactive entry neared its SLO deadline (0 when disarmed).
             "qos_early_seals": m["qos_early_seals"],
+            # Durability plane: corruption detections, the self-healing
+            # actions they triggered, and disk-full degrades — all 0 on a
+            # healthy store; the bitrot chaos audit asserts the first is > 0.
+            "integrity_errors": m["integrity_errors"],
+            "log_truncations": m["log_truncations"],
+            "leader_stepdowns": m["leader_stepdowns"],
+            "disk_degraded": m["disk_degraded"],
             "replication_rtt_ms_avg": (
                 round(1e3 * m["replication_rtt_s"] / rtt_n, 3)
                 if rtt_n else None),
@@ -1598,6 +1773,7 @@ def make_apply_command(db) -> Callable[[Any], Any]:
         # The member normally creates this table, but apply closures are
         # built before RaftMember.__init__ runs its schema script.
         db.conn.executescript(_RAFT_SCHEMA)
+        _integrity.ensure_integrity_schema(db.conn)
         db.conn.commit()
         raw = db.get_setting("shard_fence")
     # Reshard fence, cached across applies and persisted in settings so a
@@ -1685,11 +1861,13 @@ def make_apply_command(db) -> Callable[[Any], Any]:
                 return BUSY
             for i, ref in enumerate(cmd.refs):
                 blob = serialize(ref).bytes
+                consuming = serialize(
+                    ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes
                 conn.execute(
                     "INSERT OR IGNORE INTO committed_states "
-                    "(state_ref, consuming) VALUES (?, ?)",
-                    (blob, serialize(
-                        ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes))
+                    "(state_ref, consuming, crc) VALUES (?, ?, ?)",
+                    (blob, consuming,
+                     _integrity.committed_crc(blob, consuming)))
                 # Clear any hold the commit supersedes (our own retried
                 # reserve, or an expired one we just stole past).
                 conn.execute(
@@ -1714,10 +1892,12 @@ def make_apply_command(db) -> Callable[[Any], Any]:
             for ref in cmd.refs:
                 # REPLACE: refreshes our own hold on a retried reserve and
                 # deterministically steals an expired foreign one.
+                blob = serialize(ref).bytes
                 conn.execute(
                     "INSERT OR REPLACE INTO reserved_states "
-                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
-                    (serialize(ref).bytes, cmd.tx_id.bytes, expires))
+                    "(state_ref, tx_id, expires_at, crc) VALUES (?, ?, ?, ?)",
+                    (blob, cmd.tx_id.bytes, expires,
+                     _integrity.reserved_crc(blob, cmd.tx_id.bytes, expires)))
             db.commit()
             return None
 
@@ -1732,11 +1912,13 @@ def make_apply_command(db) -> Callable[[Any], Any]:
                 return UniquenessConflict(conflicts)
             for i, ref in enumerate(cmd.refs):
                 blob = serialize(ref).bytes
+                consuming = serialize(
+                    ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes
                 conn.execute(
                     "INSERT OR IGNORE INTO committed_states "
-                    "(state_ref, consuming) VALUES (?, ?)",
-                    (blob, serialize(
-                        ConsumingTx(cmd.tx_id, i, cmd.caller)).bytes))
+                    "(state_ref, consuming, crc) VALUES (?, ?, ?)",
+                    (blob, consuming,
+                     _integrity.committed_crc(blob, consuming)))
                 conn.execute(
                     "DELETE FROM reserved_states WHERE state_ref = ?",
                     (blob,))
@@ -1804,16 +1986,20 @@ def make_apply_command(db) -> Callable[[Any], Any]:
             for blob, consuming in cmd.committed_rows:
                 conn.execute(
                     "INSERT OR IGNORE INTO committed_states "
-                    "(state_ref, consuming) VALUES (?, ?)",
-                    (bytes(blob), bytes(consuming)))
+                    "(state_ref, consuming, crc) VALUES (?, ?, ?)",
+                    (bytes(blob), bytes(consuming),
+                     _integrity.committed_crc(bytes(blob), bytes(consuming))))
             for blob, tx_id, expires in cmd.reserved_rows:
                 # OR IGNORE: a retried frame never clobbers, and the hold
                 # keeps its original coordinator-stamped expires_at so the
                 # TTL backstop carries across the handoff unchanged.
                 conn.execute(
                     "INSERT OR IGNORE INTO reserved_states "
-                    "(state_ref, tx_id, expires_at) VALUES (?, ?, ?)",
-                    (bytes(blob), bytes(tx_id), float(expires)))
+                    "(state_ref, tx_id, expires_at, crc) "
+                    "VALUES (?, ?, ?, ?)",
+                    (bytes(blob), bytes(tx_id), float(expires),
+                     _integrity.reserved_crc(
+                         bytes(blob), bytes(tx_id), float(expires))))
             db.commit()
             return None
 

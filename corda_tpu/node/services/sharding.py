@@ -96,6 +96,7 @@ from ...obs import trace as _obs
 from ...qos import context as _qos
 from ...serialization.codec import deserialize
 from ...testing import faults as _faults
+from . import integrity as _integrity
 from .api import UniquenessException, UniquenessProvider
 from .raft import (
     AbortReservedCommand,
@@ -600,14 +601,29 @@ class ShardedUniquenessProvider(UniquenessProvider):
         db = self.member.db
         with db.lock:
             crows = db.conn.execute(
-                "SELECT state_ref, consuming FROM committed_states"
+                "SELECT state_ref, consuming, crc FROM committed_states"
             ).fetchall()
             rrows = db.conn.execute(
-                "SELECT state_ref, tx_id, expires_at FROM reserved_states"
+                "SELECT state_ref, tx_id, expires_at, crc "
+                "FROM reserved_states"
             ).fetchall()
-        moved_c = [(bytes(b), bytes(c)) for b, c in crows
+        # Handoff doubles as an integrity sweep: every row leaving this
+        # group is CRC-verified in passing. Detection only — the row still
+        # streams (dropping a spent-input record would un-spend it on the
+        # target, which is worse than forwarding a flagged one); repair is
+        # the scrubber/fsck's job, and the counter makes the damage visible.
+        for row in crows:
+            if row[2] is not None and _integrity.committed_crc(
+                    bytes(row[0]), bytes(row[1])) != int(row[2]):
+                self.member.metrics["integrity_errors"] += 1
+        for row in rrows:
+            if row[3] is not None and _integrity.reserved_crc(
+                    bytes(row[0]), bytes(row[1]),
+                    float(row[2])) != int(row[3]):
+                self.member.metrics["integrity_errors"] += 1
+        moved_c = [(bytes(b), bytes(c)) for b, c, _crc in crows
                    if shard_of(deserialize(bytes(b)), to_count) == target]
-        moved_r = [(bytes(b), bytes(t), float(e)) for b, t, e in rrows
+        moved_r = [(bytes(b), bytes(t), float(e)) for b, t, e, _crc in rrows
                    if shard_of(deserialize(bytes(b)), to_count) == target]
         frames, i = [], 0
         while i < max(len(moved_c), len(moved_r)) or not frames:

@@ -57,7 +57,12 @@ from ..flows.api import (
 )
 from ..obs import trace as _obs
 from ..qos import context as _qos
-from ..serialization.codec import deserialize, register, serialize
+from ..serialization.codec import (
+    DeserializationError,
+    deserialize,
+    register,
+    serialize,
+)
 from ..serialization.tokens import TokenContext
 from ..testing import faults as _faults
 from ..utils.excheckpoint import record_exception, rebuild_exception
@@ -822,7 +827,14 @@ class StateMachineManager:
                         # Session handler deregistrations that raced flow
                         # teardown (handler already gone): counted, never
                         # silently swallowed.
-                        "handler_remove_failures": 0}
+                        "handler_remove_failures": 0,
+                        # Durability plane: wire frames that failed codec
+                        # decode (hostile or damaged bytes) and checkpoints
+                        # quarantined at restore because their blob no
+                        # longer decodes — each is a flow declared failed,
+                        # never a silent drop.
+                        "undecodable_messages": 0,
+                        "checkpoints_quarantined": 0}
         # Per-flow-name timing aggregates (the JMX/Jolokia capability the
         # reference exports per-MBean, reference: Node.kt:313 — here over
         # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
@@ -975,9 +987,29 @@ class StateMachineManager:
     def _restore_checkpoints(self) -> None:
         """Rebuild flows by deterministic replay
         (reference: StateMachineManager.kt:190-226)."""
-        for blob in self.checkpoint_storage.checkpoints():
-            with self.token_context:
-                cp = deserialize(blob)
+        # items() (durability plane) yields CRC-verified (run_id, blob)
+        # pairs and quarantines rows whose checksum fails before we ever
+        # decode them; plain checkpoints() is the fallback for storage
+        # implementations that predate it.
+        items = getattr(self.checkpoint_storage, "items", None)
+        pairs = items() if items is not None else [
+            (None, blob) for blob in self.checkpoint_storage.checkpoints()]
+        for run_id, blob in pairs:
+            try:
+                with self.token_context:
+                    cp = deserialize(blob)
+            except DeserializationError as e:
+                # A checkpoint that passed (or predates) its CRC but no
+                # longer decodes is damage the codec caught: quarantine it
+                # so replay is never poisoned, and surface the loss — the
+                # flow is failed, not silently forgotten.
+                self.metrics["checkpoints_quarantined"] += 1
+                quarantine = getattr(
+                    self.checkpoint_storage, "quarantine", None)
+                if quarantine is not None and run_id is not None:
+                    quarantine(run_id, blob, f"undecodable checkpoint: {e}")
+                logger.error("quarantined undecodable checkpoint: %s", e)
+                continue
             try:
                 logic = flow_registry.create(cp.flow_name, tuple(cp.flow_args))
             except FlowException:
@@ -1406,8 +1438,11 @@ class StateMachineManager:
     def _on_session_init_message(self, message: Message) -> None:
         try:
             payload = deserialize(message.data)
-        except Exception as e:
-            # Hostile/corrupt bytes must not halt the delivery pump.
+        except DeserializationError as e:
+            # Hostile/corrupt bytes must not halt the delivery pump — but
+            # ONLY codec rejections are droppable; anything else is a real
+            # bug that must surface. Counted so node_metrics shows the rate.
+            self.metrics["undecodable_messages"] += 1
             logger.warning("dropping undecodable init message: %s", e)
             return
         if not isinstance(payload, SessionInit):

@@ -48,8 +48,13 @@ BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
 # bounced off a reshard fence (WrongShardEpoch) and the client re-derives
 # the shard directory; lane_queue_wait is time spent runnable behind
 # the QoS lane scheduler before the pump picked the flow (statemachine).
+# scrub is one online-scrubber / fsck verification pass over a store's
+# integrity-framed tables (node/services/integrity.py); repair is one
+# self-healing action — a raft-log truncate/compact or a checkpoint
+# quarantine (raft._heal_corrupt_entry, persistence.quarantine).
 DIRECT_STAGES = ("verify_wait", "admission_wait", "epoch_wait",
-                 "lane_queue_wait", "shard_reserve", "shard_commit")
+                 "lane_queue_wait", "shard_reserve", "shard_commit",
+                 "scrub", "repair")
 
 # Derived by stage_breakdown, never recorded: the reply tail is
 # root_end - max(attributed stage end).
@@ -60,7 +65,8 @@ STAGES = ("admission_wait", "epoch_wait", "queue_wait", "lane_queue_wait",
           "verify_wait",
           "device_verify", "sidecar_wait", "sidecar_verify",
           "shard_reserve", "shard_commit",
-          "raft_append", "fsync", "replication", "reply")
+          "raft_append", "fsync", "replication",
+          "scrub", "repair", "reply")
 
 # Stitch markers: recorded per trace to bound the derived reply tail and
 # anchor cross-node correlation, but not themselves breakdown stages.

@@ -20,6 +20,8 @@ point               fired from                                     actions
 ``checkpoint.write`` SMM ``_write_checkpoint``                     fail, stall, crash
 ``shard.handoff``   reshard coordinator, per streamed state frame  drop, stall, crash
 ``netmap.refresh``  Node ``refresh_netmap`` (directory reload)     drop, stall, crash
+``disk.corrupt``    raft log read path, checkpoint restore read    flip (seeded bit-flip on read)
+``disk.full``       raft append / uniqueness-provider commit       full, stall, crash
 ==================  =============================================  =======================================
 
 ``shard.handoff`` crash is the coordinator-death-mid-handoff case (the
@@ -70,6 +72,8 @@ __all__ = [
     "injected",
     "fire",
     "fire_fsync",
+    "fire_disk_corrupt",
+    "fire_disk_full",
     "plan_from_toml",
     "arm_from_env",
     "builtin_plan",
@@ -85,6 +89,8 @@ POINTS = (
     "checkpoint.write",
     "shard.handoff",
     "netmap.refresh",
+    "disk.corrupt",
+    "disk.full",
 )
 
 # Exit code used by the "crash" action so harnesses can tell an injected
@@ -225,6 +231,50 @@ def fire_fsync(point: str) -> None:
         raise OSError(f"fault injected: {point} failure")
 
 
+def fire_disk_corrupt(blob: bytes) -> bytes:
+    """Hook body for ``disk.corrupt``: when a rule fires, return *blob*
+    with ONE deterministically-chosen bit flipped (models media bitrot on
+    a read path — the stored bytes are untouched, so detection + truncate
+    + re-replication genuinely recovers).  The flipped position derives
+    from the plan seed and the point's event count, so two runs of the
+    same plan corrupt the same reads identically."""
+    plan = ACTIVE
+    if plan is None or not blob:
+        return blob
+    act = plan.fire("disk.corrupt")
+    if act is None:
+        return blob
+    action, _delay_s = act
+    if action not in ("flip", "corrupt"):
+        return blob
+    with plan._lock:
+        event = plan.events.get("disk.corrupt", 0)
+    pos = random.Random(f"{plan.seed}:disk.corrupt:bit:{event}").randrange(
+        len(blob) * 8)
+    flipped = bytearray(blob)
+    flipped[pos // 8] ^= 1 << (pos % 8)
+    return bytes(flipped)
+
+
+def fire_disk_full() -> None:
+    """Hook body for ``disk.full``: ``full``/``fail`` raises the exact
+    OperationalError sqlite produces on disk exhaustion (so catch sites
+    exercise the same string-match they use in production), ``stall``
+    sleeps."""
+    plan = ACTIVE
+    if plan is None:
+        return
+    act = plan.fire("disk.full")
+    if act is None:
+        return
+    action, delay_s = act
+    if action == "stall" and delay_s > 0:
+        time.sleep(delay_s)
+    elif action in ("full", "fail"):
+        import sqlite3
+        raise sqlite3.OperationalError("database or disk is full")
+
+
 def plan_from_toml(text: str, node_name: str | None = None) -> FaultPlan:
     """Parse a TOML plan (see module docstring for the format)."""
     try:
@@ -263,7 +313,7 @@ def arm_from_env(node_name: str | None = None) -> FaultPlan | None:
 
 def builtin_plan(name: str, node_name: str | None = None) -> FaultPlan:
     """Named plans for the chaos loadtest / bench (``lossy``, ``slow-disk``,
-    ``flaky-device``, ``reshard``)."""
+    ``flaky-device``, ``reshard``, ``bitrot``)."""
     if name == "lossy":
         # ~5% send-side loss; durable outbox re-poll recovers each loss
         # within ~1s, so the run completes with elevated tail latency.
@@ -279,6 +329,15 @@ def builtin_plan(name: str, node_name: str | None = None) -> FaultPlan:
             FaultRule("transport.send", "drop", p=0.05, max_fires=500),
             FaultRule("shard.handoff", "drop", p=0.25, max_fires=8),
             FaultRule("netmap.refresh", "drop", p=0.10, max_fires=20),
+        ], node_name=node_name)
+    if name == "bitrot":
+        # Storage-corruption soak (durability plane, round 14): seeded
+        # bit-flips on the raft-log read path plus two bounded disk-full
+        # write failures. Detection (crc mismatch) turns each flip into a
+        # truncate-and-lag repair; the exactly-once audit must still hold.
+        return FaultPlan(23, [
+            FaultRule("disk.corrupt", "flip", p=0.02, max_fires=6),
+            FaultRule("disk.full", "full", p=0.05, after=40, max_fires=2),
         ], node_name=node_name)
     if name == "slow-disk":
         return FaultPlan(11, [

@@ -290,6 +290,12 @@ class ChaosResult:
     cross_requested: int = 0
     per_group_committed: list = field(default_factory=list)
     reserved_leaked: int | None = None
+    # Durability plane: corruption detections summed over every member's
+    # raft stamp (> 0 proves a disk.corrupt plan actually fired AND was
+    # caught), and the post-run fsck gate verdict over every surviving
+    # node's store (None = gate skipped, e.g. a node died un-stopped).
+    integrity_errors: int = 0
+    fsck_clean: bool | None = None
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -519,6 +525,12 @@ def run_chaos_loadtest(
         if killed_at is not None:
             after = [t for t in completions if t > killed_at]
             recovery = round(min(after) - killed_at, 3) if after else None
+        # Durability audit: detections counted by the replicas themselves
+        # (read BEFORE stop() — stamps need live members).
+        integrity_errors = sum(
+            n.raft_member.stamp()["integrity_errors"]
+            for row in group_nodes for n in row
+            if getattr(n, "raft_member", None) is not None)
         srt = sorted(lat) or [0.0]
         result = ChaosResult(
             plan=(getattr(plan, "name", None) or str(plan)
@@ -546,11 +558,24 @@ def run_chaos_loadtest(
             cross_requested=cross_requested,
             per_group_committed=per_group_committed,
             reserved_leaked=reserved_leaked,
+            integrity_errors=integrity_errors,
         )
         if trace:
             result.trace_file = _write_trace(trace, _inproc_trace_snapshot())
         for n in nodes:
             n.stop()
+        # Post-run fsck gate: every surviving node's STORED bytes must
+        # verify clean after the soak. Runs with faults disarmed (below the
+        # finally would be too late for the report), so an injected
+        # read-path bit-flip — which never touches disk — does not fail the
+        # gate, while real on-disk damage (or a torn write) does.
+        was_armed, faults.ACTIVE = faults.ACTIVE, None
+        try:
+            from .fsck import fsck_paths
+
+            result.fsck_clean = fsck_paths(base)["clean"]
+        finally:
+            faults.ACTIVE = was_armed
         return result
     finally:
         if plan_obj is not None:
@@ -592,6 +617,9 @@ class ReshardResult:
     p99_during_ms: float  # completions inside the transition window
     p99_after_ms: float   # completions after every member cut over
     faults_injected: dict = field(default_factory=dict)
+    # Post-run fsck gate over every node's store (durability plane);
+    # None = gate skipped.
+    fsck_clean: bool | None = None
 
     def to_json(self) -> str:
         return json.dumps(self.__dict__)
@@ -845,6 +873,15 @@ def run_reshard_loadtest(
         )
         for n in nodes:
             n.stop()
+        # Post-run fsck gate (durability plane): a reshard soak rewrites
+        # whole ledgers across groups — every store must still verify.
+        was_armed, faults.ACTIVE = faults.ACTIVE, None
+        try:
+            from .fsck import fsck_paths
+
+            result.fsck_clean = fsck_paths(base)["clean"]
+        finally:
+            faults.ACTIVE = was_armed
         return result
     finally:
         if plan_obj is not None:

@@ -28,6 +28,7 @@ def _stub_phases(monkeypatch):
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
                  "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
                  "bench_reshard",  # ditto: live split + merge in-process nets
+                 "bench_durability",  # ditto: a bitrot chaos soak + fsck
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -79,6 +80,9 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # The flagship is the adaptive-coalesce A/B wrapper on both paths.
     assert report["baseline_configs"]["raft_validating_3node"] == {
         "stub": "bench_validating_flagship"}
+    # The durability section (round 14) rides the device phase path — the
+    # host-only path asserts it separately; schema parity both ways.
+    assert report["durability"] == {"stub": "bench_durability"}
     assert "phase" not in report
 
 
@@ -142,6 +146,7 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_reshard"}
     assert report["baseline_configs"]["raft_validating_3node"] == {
         "stub": "bench_validating_flagship"}
+    assert report["durability"] == {"stub": "bench_durability"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
 
 
@@ -650,3 +655,68 @@ def test_total_crash_still_prints_one_line(monkeypatch, capsys):
     report = json.loads(out[0])
     assert "crash in" in report["error"]
     assert "totally unexpected" in report["error"]
+
+
+def _fake_chaos_result(**over):
+    from corda_tpu.tools.loadtest import ChaosResult
+
+    base = dict(
+        plan="bitrot", tx_requested=60, tx_committed=60, tx_rejected=0,
+        tx_unresolved=0, exactly_once=True, cluster_committed=60,
+        duration_s=4.0, tx_per_sec=15.0, p50_ms=40.0, p99_ms=220.0,
+        faults_injected={"disk.corrupt:flip": 3},
+        integrity_errors=3, fsck_clean=True)
+    base.update(over)
+    return ChaosResult(**base)
+
+
+def test_durability_report_contract(monkeypatch):
+    """The durability section's one-line-JSON contract (round 14): a
+    bitrot chaos soak whose corruption is detected AND healed with the
+    exactly-once audit intact, plus the cold detect/repair micro — with
+    the verdict keys hoisted flat (exactly_once, integrity_errors,
+    fsck_clean, detect_ms, repair_s) so trend tooling greps them on the
+    device and host-only phase paths alike."""
+    from corda_tpu.tools import loadtest
+
+    calls = []
+
+    def fake_chaos(**kw):
+        calls.append(kw)
+        return _fake_chaos_result()
+
+    monkeypatch.setattr(loadtest, "run_chaos_loadtest", fake_chaos)
+    out = bench.bench_durability(n_tx=60, micro_rows=64)
+
+    json.dumps(out)  # the one-line contract: fully serializable
+    assert calls[0]["plan"] == "bitrot"
+    # Headline keys, flat.
+    assert out["exactly_once"] is True
+    assert out["integrity_errors"] == 3
+    assert out["fsck_clean"] is True
+    # The micro ran for REAL on a cold store: one corrupted row found,
+    # detection latency and repair time measured, store clean afterwards.
+    micro = out["detect_repair_micro"]
+    assert micro["corrupt_found"] == 1
+    assert micro["clean_after_repair"] is True
+    assert out["detect_ms"] > 0.0
+    assert out["repair_s"] > 0.0
+    # Full audit rides under the sub-run key.
+    assert out["bitrot_chaos"]["faults_injected"] == {"disk.corrupt:flip": 3}
+
+
+def test_durability_report_isolates_subrun_errors(monkeypatch):
+    """A chaos sub-run failure must cost only its own keys: the micro
+    still measures (and vice versa, the section never raises)."""
+    from corda_tpu.tools import loadtest
+
+    def boom(**kw):
+        raise RuntimeError("cluster failed to elect")
+
+    monkeypatch.setattr(loadtest, "run_chaos_loadtest", boom)
+    out = bench.bench_durability(n_tx=60, micro_rows=64)
+    json.dumps(out)
+    assert "RuntimeError" in out["bitrot_chaos"]["error"]
+    assert "exactly_once" not in out  # never fabricated from a dead run
+    assert out["detect_repair_micro"]["clean_after_repair"] is True
+    assert out["repair_s"] > 0.0
