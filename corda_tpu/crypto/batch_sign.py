@@ -1,0 +1,120 @@
+"""Columnar batch Ed25519 signing — the ingest mirror of the verify plane.
+
+The verify direction already runs columnar (provider.py packs (N, 32)
+key/msg/sig arrays and dispatches one batch to `_cverify.c` or the device);
+the SUBMIT direction still paid per-item Python: one `fast_ed25519.sign`
+call per signature, which on a host without the `cryptography` wheel
+degrades to the ~250 ops/s pure-Python oracle — the measured core of the
+~150 tx/s-per-process loadgen ceiling (ROADMAP item 2). This module packs
+a whole corpus of (seed, message) jobs into two contiguous n*32-byte
+buffers — the same word-major packing discipline as `_cverify.c`'s
+pack_words, one layer up — and signs them in ONE native call with the GIL
+released (`_cverify.sign_many`, pthread fan-out).
+
+Byte-identity: RFC 8032 signing is fully deterministic, so libcrypto's
+output is bit-identical to `fast_ed25519.sign` (and the `ref_ed25519`
+oracle) — the same argument fast_ed25519 makes for OpenSSL, one batch
+wider. There is no accept-set subtlety as in verify (no S < L corner on
+the signing side); parity is conformance-tested per width in
+tests/test_batch_sign.py. When the native module is unavailable (no
+compiler, CORDA_TPU_NO_NATIVE=1) or a message is not 32 bytes, jobs fall
+back to `fast_ed25519.sign` per item — identical bytes, reference speed.
+"""
+
+from __future__ import annotations
+
+from . import fast_ed25519
+
+
+def _native():
+    # Deferred, memoised import: the firehose imports this module inside
+    # node processes that may predate the compiler toolchain.
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE_TRIED = True
+        try:
+            from ..native import load_cverify
+
+            mod = load_cverify()
+            _NATIVE = getattr(mod, "sign_many", None)  # old .so: absent
+        except Exception:
+            _NATIVE = None
+    return _NATIVE
+
+
+_NATIVE = None
+_NATIVE_TRIED = False
+
+
+def pack_jobs(seeds, msgs) -> "tuple[bytes, bytes] | None":
+    """Columnar packing: (seeds, msgs) job lists -> two contiguous
+    n*32-byte buffers (the `_cverify.c`-parity layout, lane i at byte
+    offset 32*i). Returns None when any job is ineligible for the
+    fixed-width native path (seed or message not exactly 32 bytes) —
+    ineligible batches take the per-item fallback, never a truncated
+    buffer."""
+    if any(len(s) != 32 for s in seeds) or any(len(m) != 32 for m in msgs):
+        return None
+    return b"".join(bytes(s) for s in seeds), b"".join(
+        bytes(m) for m in msgs)
+
+
+def sign_batch(seeds, msgs) -> list[bytes]:
+    """Sign N (seed, message) jobs columnar; returns N 64-byte signatures
+    in job order, byte-identical to calling fast_ed25519.sign per job.
+
+    One native call signs the whole batch with the GIL released; the
+    node's transport/bridge threads keep moving while the corpus signs.
+    Any native failure (or ineligible job shapes) re-signs on the Python
+    path — deterministic signing means the fallback is byte-identical,
+    just slower, so a batch can never silently carry a wrong signature.
+    """
+    if len(seeds) != len(msgs):
+        raise ValueError(
+            f"sign_batch length mismatch: {len(seeds)} seeds, "
+            f"{len(msgs)} msgs")
+    n = len(seeds)
+    if n == 0:
+        return []
+    native = _native()
+    if native is not None:
+        packed = pack_jobs(seeds, msgs)
+        if packed is not None:
+            try:
+                sigs = native(packed[0], packed[1])
+                return [sigs[64 * i:64 * i + 64] for i in range(n)]
+            except ValueError:
+                pass  # malformed batch or libcrypto fault: Python re-sign
+    return [fast_ed25519.sign(seeds[i], msgs[i]) for i in range(n)]
+
+
+def sign_builders(builders, keypairs_per_builder) -> int:
+    """Batch-sign a corpus of TransactionBuilders: ONE columnar sign over
+    every (builder, key) job, then attach signatures in exactly the order
+    a per-builder `sign_with` loop would — the output SignedTransactions
+    are byte-identical to the per-tx path (parity-tested).
+
+    `keypairs_per_builder` is a parallel sequence: builders[i] is signed
+    by every KeyPair in keypairs_per_builder[i], in order. Returns the
+    number of signatures attached."""
+    from .keys import DigitalSignature
+
+    seeds: list[bytes] = []
+    msgs: list[bytes] = []
+    slots: list = []  # (builder, keypair) parallel to the job arrays
+    for builder, keys in zip(builders, keypairs_per_builder):
+        # Forces the wire build (Merkle id) exactly as sign_with's
+        # `self._wire_cached().id` does; the dedupe check below mirrors
+        # sign_with's "already signed by this key" guard.
+        msg = builder._wire_cached().id.bytes
+        for kp in keys:
+            if any(s.by == kp.public for s in builder.current_sigs):
+                continue
+            seeds.append(kp.private.seed)
+            msgs.append(msg)
+            slots.append((builder, kp))
+    sigs = sign_batch(seeds, msgs)
+    for (builder, kp), sig in zip(slots, sigs):
+        builder.current_sigs.append(
+            DigitalSignature.WithKey(bytes=sig, by=kp.public))
+    return len(slots)

@@ -567,6 +567,11 @@ class TcpMessaging(MessagingService):
             "poison_pending": len(self._poison),
             "poison_drops": self._poison_drops,
             "poison_retry_limit": self.POISON_RETRIES,
+            # Total frames enqueued for the wire: singleton appends plus
+            # every member of an append_many burst. Divided by the
+            # firehose's requested tx count this is frames-per-tx — the
+            # client-side wire amortization the ingest plane targets.
+            "frames_sent_total": ob["appends"] + ob["burst_frames"],
         }
 
     def _ensure_bridge(self, peer: str) -> None:

@@ -807,6 +807,14 @@ class StateMachineManager:
         self.async_verify = None
         self.recent_results: dict[bytes, FlowFuture] = {}
         self._pumping = False
+        # Session-send coalescer (round 15): sends issued while the pump is
+        # running are buffered and flushed at pump-end as per-destination
+        # multi-frame bursts (transport send_many), so a burst of N flow
+        # starts costs O(destinations) transport round-trips instead of N.
+        # Each entry carries the obs/qos contexts captured at the flow step
+        # that queued it — the transport stamps frames from thread-locals
+        # at SEND time, so the flush re-installs them per group.
+        self._send_buffer: list = []
         # Optional on-demand network-map refresh (set by the node assembly):
         # consulted once when a send target is missing from the cache.
         self.netmap_refresh: Callable[[], None] | None = None
@@ -834,7 +842,15 @@ class StateMachineManager:
                         # longer decodes — each is a flow declared failed,
                         # never a silent drop.
                         "undecodable_messages": 0,
-                        "checkpoints_quarantined": 0}
+                        "checkpoints_quarantined": 0,
+                        # Ingest plane: session sends issued from inside a
+                        # pump (coalescer-eligible), bursts actually shipped
+                        # via transport send_many, and frames those bursts
+                        # carried — frames/burst is the client-side wire
+                        # amortization the round-15 firehose relies on.
+                        "session_sends": 0,
+                        "session_bursts": 0,
+                        "session_burst_frames": 0}
         # Per-flow-name timing aggregates (the JMX/Jolokia capability the
         # reference exports per-MBean, reference: Node.kt:313 — here over
         # RPC node_metrics + /api/metrics): count / total_ms / max_ms per
@@ -1100,9 +1116,70 @@ class StateMachineManager:
                 if self._verify_queue and not self.defer_verify:
                     self._flush_verify_batch()
                     continue
+                # Ship buffered session sends as coalesced bursts INSIDE
+                # the loop: on the in-memory transport delivery is
+                # synchronous and may mark flows runnable again — flushing
+                # after the loop would strand them parked.
+                if self._flush_session_sends():
+                    continue
                 break
         finally:
             self._pumping = False
+            # Safety net: an exception mid-pump must not strand buffered
+            # frames (their flows already suspended expecting delivery).
+            # _pumping is already False, so re-entrant pumps from any
+            # synchronous delivery run fresh.
+            if self._send_buffer:
+                self._flush_session_sends()
+
+    def _flush_session_sends(self) -> bool:
+        """Ship every buffered session send, grouped into per-destination
+        multi-frame bursts (transport send_many) when contexts allow;
+        returns True if anything was sent. Grouping key includes session
+        topic, destination and the CAPTURED obs/qos contexts — per-session
+        frame order is preserved (a session's frames stay in queue order
+        inside one group) and traced/QoS-labelled frames keep their own
+        stamps (they degrade to smaller groups rather than borrowing the
+        flush thread's context)."""
+        if not self._send_buffer:
+            return False
+        buffered, self._send_buffer = self._send_buffer, []
+        groups: dict = {}  # key -> [address, obs_ctx, qos_ctx, items]
+        order: list = []
+        for ts, blob, address, obs_ctx, qos_ctx in buffered:
+            key = (ts.topic, ts.session_id, str(address), obs_ctx,
+                   id(qos_ctx))
+            g = groups.get(key)
+            if g is None:
+                groups[key] = g = [address, obs_ctx, qos_ctx, []]
+                order.append(key)
+            g[3].append((ts, blob))
+        send_many = getattr(self.messaging, "send_many", None)
+        outer_obs = _obs.get_context()
+        outer_qos = _qos.get_context()
+        try:
+            for key in order:
+                address, obs_ctx, qos_ctx, items = groups[key]
+                if obs_ctx is not None:
+                    _obs.set_context(*obs_ctx)
+                else:
+                    _obs.clear_context()
+                _qos.set_context(qos_ctx)
+                if send_many is not None and len(items) > 1:
+                    send_many(items[0][0], [blob for _, blob in items],
+                              address)
+                    self.metrics["session_bursts"] += 1
+                    self.metrics["session_burst_frames"] += len(items)
+                else:
+                    for ts, blob in items:
+                        self.messaging.send(ts, blob, address)
+        finally:
+            if outer_obs is not None:
+                _obs.set_context(*outer_obs)
+            else:
+                _obs.clear_context()
+            _qos.set_context(outer_qos)
+        return True
 
     def flush_pending_verifies(self) -> int:
         """Flush the accumulated verify micro-batch (deferred mode); returns
@@ -1429,11 +1506,19 @@ class StateMachineManager:
                 .get_node_by_legal_identity(party)
         if node is None:
             raise FlowException(f"don't know where to send to {party}")
-        self.messaging.send(
-            TopicSession(SESSION_TOPIC, session_id or DEFAULT_SESSION_ID),
-            serialize(payload).bytes,
-            node.address,
-        )
+        ts = TopicSession(SESSION_TOPIC, session_id or DEFAULT_SESSION_ID)
+        blob = serialize(payload).bytes
+        if self._pumping:
+            # Mid-pump: defer to the pump-end flush so a burst of flow
+            # steps ships as ONE multi-frame transport call per
+            # destination. Contexts are captured NOW (this flow's step
+            # installed them); the flush re-installs them before sending.
+            self.metrics["session_sends"] += 1
+            self._send_buffer.append(
+                (ts, blob, node.address, _obs.get_context(),
+                 _qos.get_context()))
+            return
+        self.messaging.send(ts, blob, node.address)
 
     def _on_session_init_message(self, message: Message) -> None:
         try:

@@ -32,20 +32,15 @@ NotaryClientFlow instances) checkpoint normally.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
-from ..contracts.structures import Command
 from ..crypto.keys import KeyPair
 from ..flows.api import FlowLogic, register_flow
 from ..flows.notary import NotaryClientFlow
 from ..serialization.codec import register
-from ..testing.dummies import (
-    DummyCreate,
-    DummyMove,
-    DummyMultiOwnerState,
-)
-from ..transactions.builder import TransactionBuilder
+from .ingest import IngestStats, build_chunk_columnar
 
 
 @register
@@ -73,6 +68,14 @@ class FirehoseResult:
     # sweep separates shed load from genuine conflicts with these.
     lane: str = ""
     shed: int = 0
+    # Ingest (round-15) client-plane attribution: columnar prepare
+    # throughput and the process CPU this firehose consumed. Defaulted so
+    # older drivers deserialize newer results (ClientReply precedent).
+    tx_built_per_s: float = 0.0
+    sigs_signed_per_s: float = 0.0
+    serialize_ms: float = 0.0
+    prepare_s: float = 0.0
+    cpu_s: float = 0.0
 
 
 class _Firehose:
@@ -82,7 +85,11 @@ class _Firehose:
     because every generated state is fresh)."""
 
     BURST_CAP = 512  # max flow starts admitted per scheduling round
-    PREPARE_CHUNK = 64  # transactions built+signed per prepare round
+    # Transactions built+signed per prepare round. Columnar prepare
+    # (ingest.build_chunk_columnar) amortizes signing into ONE native
+    # batch per chunk, so bigger chunks are cheaper per tx — 256 keeps a
+    # round under ~100 ms while the node still services its run loop.
+    PREPARE_CHUNK = 256
 
     def __init__(self, flow: "FirehoseFlow"):
         self.flow = flow
@@ -109,7 +116,9 @@ class _Firehose:
         self.cross_committed = 0
         self.sigs_signed = 0
         self.latencies: list[float] = []
+        self.ingest = IngestStats()  # columnar prepare attribution
         self.t0: float | None = None  # set when the measured phase begins
+        self._cpu0 = 0.0  # process CPU mark at measured-phase start
         # Sharded topology (if any) from the netmap: routes each move to
         # its owning group's first member so single-shard traffic takes the
         # fast path (without this every request lands on one arbitrary
@@ -131,61 +140,33 @@ class _Firehose:
             raise RuntimeError("no notary advertised in the network map")
         return notary
 
-    def _issue_one(self, marker: int):
-        """One recorded issuance; returns its output ref's StateRef."""
-        issue = TransactionBuilder(notary=self.notary)
-        issue.add_output_state(
-            DummyMultiOwnerState(marker, self.owners))
-        issue.add_command(Command(DummyCreate(),
-                                  (self.issuer.public.composite,)))
-        issue.sign_with(self.issuer)
-        self.sigs_signed += 1
-        issue_stx = issue.to_signed_transaction()
-        self.flow.record_transactions([issue_stx])  # with provenance
-        return issue_stx.tx.out_ref(0)
-
     def _route(self, state_and_ref):
         """Member Party of the shard group owning a StateAndRef's ref
         (None when the notary is unsharded)."""
+        return self._route_ref(state_and_ref.ref)
+
+    def _route_ref(self, ref):
+        """Same routing from a bare StateRef (replay workers only carry
+        the deserialized wire, not StateAndRefs)."""
         if self.directory is None:
             return None
         from ..node.services.sharding import shard_of
 
         count, groups = self.directory
-        members = groups.get(shard_of(state_and_ref.ref, count))
+        members = groups.get(shard_of(ref, count))
         return members[0] if members else None
 
-    def _build_one(self, i: int):
-        """Issue (recorded locally, as in NotaryDemo) + signed move. Every
-        `_cross_every`-th move consumes TWO issued states owned by
-        DIFFERENT shards (re-issuing with a varied marker until the second
-        ref hashes into another group), forcing the 2PC path."""
-        cross = bool(self._cross_every) and i % self._cross_every == 0
-        refs = [self._issue_one(i * 1_000_003)]
-        if cross:
-            self.cross_requested += 1
-            for attempt in range(1, 17):
-                ref2 = self._issue_one(i * 1_000_003 + attempt)
-                if self.directory is None:
-                    break
-                from ..node.services.sharding import shard_of
-
-                count = self.directory[0]
-                if shard_of(ref2.ref, count) != shard_of(refs[0].ref, count):
-                    break  # spans two groups (expected ~n/(n-1) tries)
-            refs.append(ref2)
-
-        move = TransactionBuilder(notary=self.notary)
-        for ref in refs:
-            move.add_input_state(ref)
-        move.add_command(Command(DummyMove(), self.owners))
-        move.add_output_state(
-            DummyMultiOwnerState(i, self.owners))
-        for key in self.keys:
-            move.sign_with(key)
-        self.sigs_signed += len(self.keys)
-        stx = move.to_signed_transaction(check_sufficient_signatures=False)
-        return stx, self._route(refs[0]), cross
+    def _prepare_round(self) -> bool:
+        """One prepare round; True once the corpus is complete. The base
+        engine builds columnar (ingest.build_chunk_columnar replaced the
+        retired per-tx `_build_one` loop: byte-identical output, one
+        batched sign + one record_transactions per chunk)."""
+        if len(self.corpus) < self.flow.n_tx:
+            k = min(self.PREPARE_CHUNK, self.flow.n_tx - len(self.corpus))
+            self.corpus.extend(
+                build_chunk_columnar(self, len(self.corpus), k, self.ingest))
+            return False  # the clock starts on a LATER round
+        return True
 
     def _admit_quota(self) -> int:
         """How many new flows this round may start."""
@@ -203,13 +184,11 @@ class _Firehose:
                           self.BURST_CAP))
 
     def poll(self):
-        if len(self.corpus) < self.flow.n_tx:
-            for _ in range(min(self.PREPARE_CHUNK,
-                               self.flow.n_tx - len(self.corpus))):
-                self.corpus.append(self._build_one(len(self.corpus)))
+        if not self._prepare_round():
             return None  # still preparing; the clock has not started
         if self.t0 is None:
             self.t0 = time.perf_counter()
+            self._cpu0 = time.process_time()
         from ..qos import context as _qos
 
         lane = getattr(self.flow, "lane", "")
@@ -267,6 +246,14 @@ class _Firehose:
             cross_committed=self.cross_committed,
             lane=getattr(self.flow, "lane", ""),
             shed=self.shed,
+            tx_built_per_s=self.ingest.tx_built_per_s,
+            sigs_signed_per_s=self.ingest.sigs_signed_per_s,
+            serialize_ms=self.ingest.serialize_ms,
+            prepare_s=round(self.ingest.prepare_s, 4),
+            # Total process CPU attributable to this firehose: columnar
+            # prepare plus the measured drive phase.
+            cpu_s=round(self.ingest.cpu_s
+                        + (time.process_time() - self._cpu0), 4),
         )
 
 
@@ -298,6 +285,157 @@ class FirehoseFlow(FlowLogic):
         return result
 
 
+@register
+@dataclass(frozen=True)
+class IngestBuildResult:
+    """Summary of a pre-built, pre-serialized corpus (IngestBuildFlow)."""
+
+    path: str
+    n_tx: int
+    sigs_signed: int
+    bytes_written: int
+    tx_built_per_s: float
+    sigs_signed_per_s: float
+    serialize_ms: float
+    prepare_s: float
+    cpu_s: float
+    cross_requested: int = 0
+
+
+class _IngestBuild(_Firehose):
+    """Build + sign + serialize a corpus to a multi-tx frame on disk,
+    WITHOUT driving any load: the multiprocess firehose's prepare stage.
+    Replay workers map disjoint slices of the written frame, so they
+    never rebuild or re-sign anything."""
+
+    def poll(self):
+        if not self._prepare_round():
+            return None
+        from .ingest import serialize_corpus
+
+        frame = serialize_corpus(
+            [stx for stx, _, _ in self.corpus], self.ingest)
+        tmp = self.flow.out_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+        os.replace(tmp, self.flow.out_path)  # atomic: never a torn corpus
+        st = self.ingest
+        return IngestBuildResult(
+            path=self.flow.out_path,
+            n_tx=st.n_tx,
+            sigs_signed=st.sigs_signed,
+            bytes_written=len(frame),
+            tx_built_per_s=st.tx_built_per_s,
+            sigs_signed_per_s=st.sigs_signed_per_s,
+            serialize_ms=st.serialize_ms,
+            prepare_s=round(st.prepare_s, 4),
+            cpu_s=round(st.cpu_s, 4),
+            cross_requested=self.cross_requested,
+        )
+
+
+@register_flow(name="loadgen.IngestBuildFlow")
+class IngestBuildFlow(FlowLogic):
+    """RPC-startable corpus builder: columnar build+sign n_tx moves, pack
+    them into ONE multi-tx frame at out_path, return IngestBuildResult.
+    Issue provenance is recorded on THIS node only — replay slices must
+    be driven at a non-validating notary (uniqueness does not need the
+    back chain; validation would)."""
+
+    def __init__(self, out_path: str, n_tx: int, width: int = 1,
+                 cross_frac: float = 0.0):
+        self.out_path = out_path
+        self.n_tx = n_tx
+        self.width = width
+        self.cross_frac = cross_frac
+        self.inflight = 0  # unused: this flow never starts children
+        self.rate_tx_s = 0.0
+        self.lane = ""
+        self.slo_ms = 0.0
+
+    def call(self):
+        result = yield self.service_request(lambda: _IngestBuild(self).poll)
+        return result
+
+
+class _Replay(_Firehose):
+    """Firehose engine whose prepare phase LOADS a pre-serialized corpus
+    slice instead of building one — the worker half of the multiprocess
+    firehose. Deserialization is chunked so the node's run loop keeps
+    servicing transport while the slice loads; route and cross flags are
+    re-derived from each wire's inputs (first-input shard owner; >1 input
+    = cross), so the frame needs no sidecar metadata."""
+
+    LOAD_CHUNK = 512  # wires deserialized per prepare round
+
+    def __init__(self, flow):
+        super().__init__(flow)
+        self._payloads: list | None = None
+
+    def _prepare_round(self) -> bool:
+        from ..serialization.codec import deserialize
+        from .ingest import unpack_frame
+
+        t0 = time.perf_counter()
+        cpu0 = time.process_time()
+        if self._payloads is None:
+            with open(self.flow.corpus_path, "rb") as f:
+                blob = f.read()
+            payloads = unpack_frame(blob)  # loud on any damage
+            lo = self.flow.offset
+            hi = lo + self.flow.n_tx
+            if hi > len(payloads):
+                raise RuntimeError(
+                    f"corpus slice [{lo}:{hi}) exceeds frame of "
+                    f"{len(payloads)} entries")
+            self._payloads = payloads[lo:hi]
+        done = len(self.corpus)
+        if done < self.flow.n_tx:
+            for p in self._payloads[done:done + self.LOAD_CHUNK]:
+                stx = deserialize(p)
+                inputs = stx.tx.inputs
+                cross = len(inputs) > 1
+                if cross:
+                    self.cross_requested += 1
+                if not self.flow.width:
+                    self.flow.width = len(stx.sigs)
+                self.corpus.append(
+                    (stx, self._route_ref(inputs[0]), cross))
+            self.ingest.n_tx = len(self.corpus)
+            self.ingest.prepare_s += time.perf_counter() - t0
+            self.ingest.cpu_s += time.process_time() - cpu0
+            return False
+        return True
+
+
+@register_flow(name="loadgen.FirehoseReplayFlow")
+class FirehoseReplayFlow(FlowLogic):
+    """RPC-startable replay firehose: drive a disjoint [offset, offset+
+    n_tx) slice of a pre-built multi-tx corpus frame through the notary.
+    Same admission control and result shape as FirehoseFlow; the corpus
+    was signed once by IngestBuildFlow, so the worker's own CPU is almost
+    entirely submission — the shape that lets W processes offer W× the
+    single-process rate."""
+
+    def __init__(self, corpus_path: str, offset: int, n_tx: int,
+                 inflight: int = 64, rate_tx_s: float = 0.0,
+                 lane: str = "", slo_ms: float = 0.0):
+        self.corpus_path = corpus_path
+        self.offset = offset
+        self.n_tx = n_tx
+        self.inflight = inflight
+        self.rate_tx_s = rate_tx_s
+        self.lane = lane
+        self.slo_ms = slo_ms
+        self.width = 0  # observed from the first deserialized wire
+        self.cross_frac = 0.0  # cross mix is baked into the corpus
+
+    def call(self):
+        result = yield self.service_request(lambda: _Replay(self).poll)
+        return result
+
+
 def install(node) -> None:
-    """Cordapp hook — importing the module registers the flow; nothing else
-    to wire (the firehose starts children directly on the node's SMM)."""
+    """Cordapp hook — importing the module registers the flows; nothing
+    else to wire (the firehose starts children directly on the node's
+    SMM)."""

@@ -1002,7 +1002,17 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             "reply_coalesce_ratio": raft.get("reply_coalesce_ratio"),
             "transport": transport or None,
             "outbox_burst_avg": transport.get("outbox_burst_avg"),
-            "bridge_flush_avg": transport.get("bridge_flush_avg")}
+            "bridge_flush_avg": transport.get("bridge_flush_avg"),
+            # Ingest-plane observables: total frames this node enqueued for
+            # the wire (frames / firehose tx = frames-per-tx) and the
+            # session-send coalescer's burst counters (statemachine._pump).
+            "frames_sent_total": transport.get("frames_sent_total"),
+            "session_bursts": metrics.get("session_bursts"),
+            "session_burst_frames": metrics.get("session_burst_frames"),
+            # The round stage this member spent the most wall time in — the
+            # first SERVER-side bottleneck a saturating firehose exposes.
+            "busiest_stage": (max(stage, key=stage.get)
+                              if stage else None)}
 
 
 def run_loadtest_multiprocess(
@@ -1401,17 +1411,36 @@ def _merge_firehose(values: list):
                             for v in values),
         lane=getattr(values[0], "lane", ""),
         shed=sum(getattr(v, "shed", 0) for v in values),
+        # Ingest attribution: throughput rates sum across clients (they
+        # prepared concurrently in separate processes); prepare wall is the
+        # slowest client's; CPU is the honest total burned.
+        tx_built_per_s=round(sum(getattr(v, "tx_built_per_s", 0.0)
+                                 for v in values), 1),
+        sigs_signed_per_s=round(sum(getattr(v, "sigs_signed_per_s", 0.0)
+                                    for v in values), 1),
+        serialize_ms=round(sum(getattr(v, "serialize_ms", 0.0)
+                               for v in values), 3),
+        prepare_s=round(max(getattr(v, "prepare_s", 0.0)
+                            for v in values), 4),
+        cpu_s=round(sum(getattr(v, "cpu_s", 0.0) for v in values), 4),
     )
 
 
 def run_latency_sweep(
-    rates: tuple[float, ...] = (30.0, 90.0, 150.0),
+    # Raised for round 15: columnar prepare (one native batch sign per
+    # chunk) moved the per-client ceiling off build/sign, so the stale
+    # (30, 90, 150) ladder never left the comfortable region — the top
+    # rung must sit ABOVE single-process capacity for the sweep to show
+    # a knee.
+    rates: tuple[float, ...] = (60.0, 240.0, 720.0),
     n_tx: int = 250,
     width: int = 4,
     clients: int = 1,  # client processes splitting each offered rate;
-    # one client process saturates its own GIL near ~150 tx/s, so rates
-    # above that need the load SPREAD (each paces at rate/clients) or the
-    # sweep measures the generator, not the notary
+    # one client process's measured phase saturates near a few hundred
+    # tx/s of submissions, so rates above that need the load SPREAD (each
+    # paces at rate/clients) or the sweep measures the generator, not the
+    # notary — or use run_ingest_sweep, whose replay workers skip
+    # build/sign entirely
     notary: str = "simple",  # simple | validating | raft | raft-validating
     cluster_size: int = 3,
     verifier: str = "cpu",  # notary member 0's provider (followers: cpu)
@@ -1583,7 +1612,11 @@ def run_latency_sweep(
 
 
 def run_slo_sweep(
-    rates: tuple[float, ...] = (60.0, 120.0, 240.0),
+    # Raised for round 15 (vectorized ingest): with columnar prepare the
+    # generators pace well past the old 240 top rung, so the default
+    # ladder now reaches into overload — calibrate_admission re-derives
+    # its knobs (and provenance) from whatever ladder actually ran.
+    rates: tuple[float, ...] = (120.0, 240.0, 480.0),
     n_tx: int = 240,
     width: int = 4,
     clients: int = 2,
@@ -1744,6 +1777,192 @@ def run_slo_sweep(
                        sidecar=side_stats, qos=qstats or None)
 
 
+_LOSSY_PLAN_TOML = """\
+seed = 7
+[[rule]]
+point = "transport.send"
+action = "drop"
+p = 0.05
+max_fires = 500
+"""
+
+
+def run_ingest_sweep(
+    rates: tuple[float, ...] = (1200.0, 3600.0, 10000.0),
+    n_tx: int = 2000,
+    width: int = 1,
+    workers: int = 3,  # replay worker processes splitting each offered rate
+    notary: str = "simple",  # simple | raft (validating kinds rejected:
+    # replay workers hold no issue provenance — uniqueness does not need
+    # the back chain, validation would)
+    cluster_size: int = 3,
+    cross_frac: float = 0.0,
+    verifier: str = "cpu",
+    max_sigs: int = 4096,
+    max_wait_ms: float = 2.0,
+    coalesce_ms: float = 10.0,
+    chaos: str | None = None,  # "lossy" or a fault-plan TOML path: armed
+    # (via CORDA_TPU_FAULT_PLAN) in member + worker processes, NOT the
+    # builder — the corpus build stays deterministic, delivery does not
+    base_dir: str | None = None,
+    max_seconds: float = 600.0,
+    async_verify: bool = True,
+    async_depth: int = 2,
+) -> SweepResult:
+    """The multiprocess ingest firehose: ONE builder process constructs,
+    batch-signs and serializes the whole corpus (loadgen.IngestBuildFlow →
+    a CTI1 multi-tx frame on disk), then `workers` replay processes each
+    drive a DISJOINT slice of that frame open-loop at rate/workers — no
+    worker ever rebuilds or re-signs a transaction, so the offered rate
+    scales with worker count instead of one process's build+sign ceiling.
+
+    Each rate gets a FRESH corpus (reusing one would double-spend its
+    inputs) and is isolated: a failed rate records {"error": ...} in
+    results[rate] and the sweep continues. results[rate] is otherwise a
+    flat dict: offered/achieved tx/s, commit counts, latency percentiles,
+    frames-per-tx (worker transport deltas), the builder's ingest
+    attribution block, and the exactly-once audit verdict."""
+    from ..testing.driver import driver
+
+    if "validating" in notary:
+        raise ValueError(
+            "ingest sweep requires a non-validating notary: replay "
+            "workers carry no issue provenance")
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-ingest-"))
+
+    def _extra(v: str) -> str:
+        return (f'verifier = "{v}"\n'
+                f"[batch]\nmax_sigs = {max_sigs}\n"
+                f"max_wait_ms = {max_wait_ms}\n"
+                f"coalesce_ms = {coalesce_ms}\n"
+                f"async_verify = {str(async_verify).lower()}\n"
+                f"async_depth = {async_depth}\n")
+
+    chaos_env = None
+    if chaos:
+        plan = Path(chaos)
+        if plan.suffix == ".toml" or plan.exists():
+            plan_path = str(plan)
+        elif chaos == "lossy":
+            plan_path = str(base / "fault-plan.toml")
+            base.mkdir(parents=True, exist_ok=True)
+            Path(plan_path).write_text(_LOSSY_PLAN_TOML, encoding="utf-8")
+        else:
+            raise ValueError(f"chaos: expected 'lossy' or a TOML path, "
+                             f"got {chaos!r}")
+        chaos_env = {"CORDA_TPU_FAULT_PLAN": plan_path}
+
+    results: dict = {}
+    stamps: dict = {}
+    with driver(base) as d:
+        members = _start_notary_processes(
+            d, notary, cluster_size, _extra(verifier),
+            follower_extra=_extra("cpu"), rpc=True, env_extra=chaos_env)
+        member_rpcs = []
+        for m in members:
+            member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
+            d.defer(member_rpcs[-1].close)
+        builder = d.start_node("Ingest0", rpc=True,
+                               cordapps=("corda_tpu.tools.loadgen",),
+                               extra_toml=_extra("cpu"))
+        builder_rpc = builder.rpc("demo", "s3cret", timeout=60.0)
+        d.defer(builder_rpc.close)
+        workers = max(1, workers)
+        worker_rpcs = []
+        for i in range(workers):
+            h = d.start_node(f"Worker{i}", rpc=True,
+                             cordapps=("corda_tpu.tools.loadgen",),
+                             extra_toml=_extra("cpu"), env_extra=chaos_env)
+            worker_rpcs.append(h.rpc("demo", "s3cret", timeout=60.0))
+            d.defer(worker_rpcs[-1].close)
+
+        def _await(jobs, what):
+            """jobs: [(rpc, flow_handle)] -> values, bounded wait."""
+            values: list = [None] * len(jobs)
+            deadline = time.monotonic() + max_seconds
+            while time.monotonic() < deadline:
+                for i, (r, fh) in enumerate(jobs):
+                    if values[i] is None:
+                        done, value = r.call("flow_result", fh.run_id)
+                        if done:
+                            values[i] = value
+                if all(v is not None for v in values):
+                    return values
+                time.sleep(0.1)
+            raise TimeoutError(f"{what} did not finish in {max_seconds}s")
+
+        # Warm-up: session establishment / netmap / first-contact paths
+        # run OUTSIDE the measured rates (same policy as the sweeps).
+        _await([(r, r.call("start_flow_dynamic", "loadgen.FirehoseFlow",
+                           (3, 1, 3, 0.0))) for r in worker_rpcs],
+               "ingest-sweep warmup")
+        for rate in rates:
+            try:
+                corpus_path = str(base / f"corpus-{rate:g}.bin")
+                bh = builder_rpc.call(
+                    "start_flow_dynamic", "loadgen.IngestBuildFlow",
+                    (corpus_path, n_tx, width, float(cross_frac)))
+                build = _await([(builder_rpc, bh)], f"corpus build@{rate}")[0]
+                t_before = [r.call("node_metrics").get("transport") or {}
+                            for r in worker_rpcs]
+                per_n = max(1, n_tx // workers)
+                jobs = [(r, r.call(
+                    "start_flow_dynamic", "loadgen.FirehoseReplayFlow",
+                    (corpus_path, i * per_n, per_n, 1 << 30,
+                     float(rate) / workers)))
+                    for i, r in enumerate(worker_rpcs)]
+                values = _await(jobs, f"ingest replay@{rate}")
+                t_after = [r.call("node_metrics").get("transport") or {}
+                           for r in worker_rpcs]
+                merged = _merge_firehose(values)
+                frames = sum(
+                    (a.get("frames_sent_total") or 0)
+                    - (b.get("frames_sent_total") or 0)
+                    for a, b in zip(t_after, t_before))
+                results[rate] = {
+                    "offered_tx_s": float(rate),
+                    "achieved_tx_s": merged.tx_per_sec,
+                    "requested": merged.requested,
+                    "committed": merged.committed,
+                    "rejected": merged.rejected,
+                    "duration_s": merged.duration_s,
+                    "p50_ms": merged.p50_ms,
+                    "p99_ms": merged.p99_ms,
+                    "workers": workers,
+                    "frames_per_tx": (round(frames / merged.requested, 3)
+                                      if merged.requested else None),
+                    # No tx lost, none double-counted: every requested tx
+                    # resolved exactly once as commit or loud reject.
+                    "exactly_once": (merged.committed + merged.rejected
+                                     == merged.requested),
+                    "ingest": {
+                        "tx_built_per_s": build.tx_built_per_s,
+                        "sigs_signed_per_s": build.sigs_signed_per_s,
+                        "serialize_ms": build.serialize_ms,
+                        "prepare_s": build.prepare_s,
+                        "bytes_written": build.bytes_written,
+                        "sigs_signed": build.sigs_signed,
+                        # Client-plane CPU attribution: builder prepare +
+                        # worker load/drive CPU, all processes.
+                        "cpu_s": round(build.cpu_s + merged.cpu_s, 4),
+                        "load_prepare_s": merged.prepare_s,
+                    },
+                }
+            except Exception as e:
+                # Per-sub-run isolation: one rate failing (timeout, dead
+                # worker) records an error row; later rates still run.
+                results[rate] = {"error": f"{type(e).__name__}: {e}",
+                                 "offered_tx_s": float(rate)}
+        for m, r in zip(members, member_rpcs):
+            try:
+                stamps[m.name] = _member_stamp(
+                    r.call("node_metrics"), m.device)
+            # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
+            except Exception:
+                pass  # a dead member costs its stamp, not the sweep
+    return SweepResult(results=results, node_stamps=stamps)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tx", type=int, default=100)
@@ -1821,6 +2040,14 @@ def main(argv=None) -> int:
                          "every client drives an interactive AND a bulk "
                          "firehose concurrently; prints per-lane p50/p99, "
                          "committed and shed counts plus member QoS stats")
+    ap.add_argument("--ingest-sweep", default=None, metavar="R1,R2,..",
+                    help="run the multiprocess ingest firehose: one builder "
+                         "process batch-signs and serializes the corpus to "
+                         "a multi-tx frame, --clients replay workers drive "
+                         "disjoint slices of it open-loop at each offered "
+                         "rate (tx/s, comma list); prints per-rate "
+                         "achieved tx/s, ingest attribution and the "
+                         "exactly-once verdict (optionally under --chaos)")
     args = ap.parse_args(argv)
     if args.shards and not args.processes:
         ap.error("--shards requires --processes (each shard group is a "
@@ -1834,6 +2061,19 @@ def main(argv=None) -> int:
     if args.lane and not args.processes:
         ap.error("--lane requires --processes (the QoS plane spans real "
                  "node processes; in-process mode has no lane plumbing)")
+    if args.ingest_sweep:
+        sweep = run_ingest_sweep(
+            rates=tuple(float(x) for x in args.ingest_sweep.split(",")),
+            n_tx=args.tx, width=args.width, workers=args.clients,
+            notary=args.notary, cluster_size=args.cluster_size,
+            cross_frac=args.cross_frac, verifier=args.verifier,
+            max_sigs=args.max_sigs, max_wait_ms=args.max_wait_ms,
+            chaos=args.chaos)
+        print(json.dumps({
+            "rates": {f"{rate:g}": row for rate, row in sweep.items()},
+            "node_stamps": sweep.node_stamps,
+        }))
+        return 0
     if args.offered_load:
         sweep = run_slo_sweep(
             rates=tuple(float(x) for x in args.offered_load.split(",")),

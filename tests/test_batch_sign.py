@@ -1,0 +1,231 @@
+"""Round 15 — vectorized ingest plane, client side.
+
+Two contracts under test:
+
+* **Batch-sign parity** (crypto/batch_sign.py): the columnar signer must
+  be byte-identical to the per-tx `TransactionBuilder.sign_with` loop —
+  RFC 8032 signing is deterministic, so the native batch path, the
+  Python fallback and the per-item reference all produce the same 64
+  bytes, across widths 1/4/64 and composite owner keys; a tampered
+  signature must still reject loudly downstream.
+
+* **Multi-tx frame codec** (tools/ingest.py): `pack_frame`/`unpack_frame`
+  round-trips exactly, and any damage — bad magic, truncated length or
+  body, trailing junk, an oversize entry count — raises
+  DeserializationError before ANY entry applies (all-or-nothing).
+"""
+
+import struct
+
+import pytest
+
+from corda_tpu.contracts.structures import Command
+from corda_tpu.crypto import batch_sign, fast_ed25519
+from corda_tpu.crypto.composite import CompositeKey
+from corda_tpu.crypto.keys import DigitalSignature
+from corda_tpu.serialization.codec import DeserializationError, serialize
+from corda_tpu.testing.dummies import (
+    DummyCreate,
+    DummyMove,
+    DummyMultiOwnerState,
+)
+from corda_tpu.testing.identities import DUMMY_NOTARY, entropy_keypair
+from corda_tpu.tools.ingest import (
+    FRAME_MAGIC,
+    MAX_FRAME_ENTRIES,
+    deserialize_corpus,
+    pack_frame,
+    serialize_corpus,
+    unpack_frame,
+)
+from corda_tpu.transactions.builder import TransactionBuilder
+from corda_tpu.transactions.signed import SignatureError, SignedTransaction
+
+
+def _corpus_builders(n, owners, issuer, base=0):
+    """n (issue, move) builder pairs in the firehose's shape: an issued
+    multi-owner state spent by a width-signed move. Deterministic content
+    so two calls build byte-identical wire forms."""
+    issues, moves = [], []
+    for i in range(n):
+        issue = TransactionBuilder(notary=DUMMY_NOTARY)
+        issue.add_output_state(DummyMultiOwnerState(base + i, owners))
+        issue.add_command(Command(DummyCreate(), (issuer.public.composite,)))
+        move = TransactionBuilder(notary=DUMMY_NOTARY)
+        move.add_input_state(issue._wire_cached().out_ref(0))
+        move.add_command(Command(DummyMove(), owners))
+        move.add_output_state(DummyMultiOwnerState(base + i + n, owners))
+        issues.append(issue)
+        moves.append(move)
+    return issues, moves
+
+
+def _stx_bytes(builder):
+    return serialize(builder.to_signed_transaction(
+        check_sufficient_signatures=False)).bytes
+
+
+@pytest.mark.parametrize("width", [1, 4, 64])
+def test_sign_builders_byte_identical_to_sign_with(width):
+    issuer = entropy_keypair(1000 + width)
+    keys = [entropy_keypair(2000 + width * 100 + i) for i in range(width)]
+    owners = tuple(k.public.composite for k in keys)
+    n = 1 if width == 64 else 2
+
+    # Per-tx reference: the retired prepare loop, one sign_with per sig.
+    ref_issues, ref_moves = _corpus_builders(n, owners, issuer)
+    for b in ref_issues:
+        b.sign_with(issuer)
+    for b in ref_moves:
+        for k in keys:
+            b.sign_with(k)
+
+    # Columnar path: ONE sign_batch over every job in the corpus.
+    issues, moves = _corpus_builders(n, owners, issuer)
+    attached = batch_sign.sign_builders(
+        issues + moves, [(issuer,)] * n + [keys] * n)
+    assert attached == n * (1 + width)
+
+    for ref, got in zip(ref_issues + ref_moves, issues + moves):
+        assert _stx_bytes(got) == _stx_bytes(ref)
+    # And the signatures actually verify, not merely match each other.
+    for b in moves:
+        b.to_signed_transaction(
+            check_sufficient_signatures=False).check_signatures_are_valid()
+
+
+def test_sign_builders_parity_composite_owner_keys():
+    """A 2-of-2 composite owner: both leaves sign the move; the batch
+    path must attach the same bytes in the same order as sign_with."""
+    issuer = entropy_keypair(3000)
+    k1, k2 = entropy_keypair(3001), entropy_keypair(3002)
+    composite = CompositeKey.Builder().add_keys(
+        k1.public, k2.public).build(threshold=2)
+    owners = (composite,)
+
+    ref_issues, ref_moves = _corpus_builders(2, owners, issuer, base=50)
+    for b in ref_issues:
+        b.sign_with(issuer)
+    for b in ref_moves:
+        b.sign_with(k1)
+        b.sign_with(k2)
+
+    issues, moves = _corpus_builders(2, owners, issuer, base=50)
+    batch_sign.sign_builders(
+        issues + moves, [(issuer,)] * 2 + [(k1, k2)] * 2)
+    for ref, got in zip(ref_issues + ref_moves, issues + moves):
+        assert _stx_bytes(got) == _stx_bytes(ref)
+    stx = moves[0].to_signed_transaction(check_sufficient_signatures=False)
+    stx.check_signatures_are_valid()
+    assert not stx.get_missing_signatures() & {composite}
+
+
+def test_sign_builders_skips_already_signed_key():
+    issuer = entropy_keypair(4000)
+    key = entropy_keypair(4001)
+    owners = (key.public.composite,)
+    issues, moves = _corpus_builders(1, owners, issuer, base=70)
+    moves[0].sign_with(key)
+    # Mirrors sign_with's dedupe guard, minus the loop's hard raise: a
+    # pre-signed key costs nothing and attaches nothing.
+    attached = batch_sign.sign_builders(
+        issues + moves, [(issuer,), (key,)])
+    assert attached == 1  # the issuer sig only
+    assert len(moves[0].current_sigs) == 1
+
+
+def test_tampered_batch_signature_rejects():
+    issuer = entropy_keypair(5000)
+    key = entropy_keypair(5001)
+    owners = (key.public.composite,)
+    issues, moves = _corpus_builders(1, owners, issuer, base=90)
+    batch_sign.sign_builders(issues + moves, [(issuer,), (key,)])
+    stx = moves[0].to_signed_transaction(check_sufficient_signatures=False)
+    stx.check_signatures_are_valid()
+    good = stx.sigs[0]
+    bad = DigitalSignature.WithKey(
+        bytes=bytes([good.bytes[0] ^ 1]) + good.bytes[1:], by=good.by)
+    with pytest.raises(SignatureError):
+        SignedTransaction.of(stx.tx, [bad]).check_signatures_are_valid()
+
+
+def test_sign_batch_native_and_python_paths_agree(monkeypatch):
+    seeds = [entropy_keypair(6000 + i).private.seed for i in range(8)]
+    msgs = [bytes([i]) * 32 for i in range(8)]
+    sigs = batch_sign.sign_batch(seeds, msgs)
+    # Forcing the per-item fallback must not change a single byte.
+    monkeypatch.setattr(batch_sign, "_NATIVE", None)
+    monkeypatch.setattr(batch_sign, "_NATIVE_TRIED", True)
+    assert batch_sign.sign_batch(seeds, msgs) == sigs
+    assert sigs == [fast_ed25519.sign(s, m) for s, m in zip(seeds, msgs)]
+
+
+def test_sign_batch_irregular_messages_fall_back_identically():
+    # A non-32-byte message is ineligible for the fixed-width native
+    # packing; the whole batch takes the per-item path, same bytes.
+    seeds = [entropy_keypair(6100 + i).private.seed for i in range(3)]
+    msgs = [b"short", b"x" * 32, b"y" * 100]
+    assert batch_sign.pack_jobs(seeds, msgs) is None
+    assert batch_sign.sign_batch(seeds, msgs) == [
+        fast_ed25519.sign(s, m) for s, m in zip(seeds, msgs)]
+
+
+def test_sign_batch_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        batch_sign.sign_batch([b"\0" * 32], [])
+    assert batch_sign.sign_batch([], []) == []
+
+
+# -- multi-tx frame codec ----------------------------------------------------
+
+
+def test_frame_round_trip():
+    payloads = [b"", b"x", b"payload" * 97, bytes(range(256))]
+    assert unpack_frame(pack_frame(payloads)) == payloads
+    assert unpack_frame(pack_frame([])) == []
+
+
+def test_frame_rejects_bad_magic():
+    frame = pack_frame([b"abc"])
+    with pytest.raises(DeserializationError, match="magic"):
+        unpack_frame(b"JUNK" + frame[4:])
+    with pytest.raises(DeserializationError, match="magic"):
+        unpack_frame(b"")
+
+
+def test_frame_rejects_truncation():
+    frame = pack_frame([b"abc", b"defgh"])
+    # Cut inside the last entry's body, and inside a length prefix:
+    # both must reject loudly, never return the valid prefix.
+    with pytest.raises(DeserializationError, match="truncated"):
+        unpack_frame(frame[:-1])
+    with pytest.raises(DeserializationError, match="truncated"):
+        unpack_frame(frame[:8 + 2])
+    # Count says 2, stream holds 1 entry.
+    short = FRAME_MAGIC + struct.pack("<I", 2) + frame[8:8 + 4 + 3]
+    with pytest.raises(DeserializationError, match="truncated"):
+        unpack_frame(short)
+
+
+def test_frame_rejects_trailing_junk():
+    with pytest.raises(DeserializationError, match="trailing"):
+        unpack_frame(pack_frame([b"abc"]) + b"!")
+
+
+def test_frame_rejects_oversize_count():
+    blob = FRAME_MAGIC + struct.pack("<I", MAX_FRAME_ENTRIES + 1)
+    with pytest.raises(DeserializationError, match="oversize"):
+        unpack_frame(blob)
+
+
+def test_corpus_round_trip_through_frame():
+    issuer = entropy_keypair(7000)
+    key = entropy_keypair(7001)
+    owners = (key.public.composite,)
+    issues, moves = _corpus_builders(3, owners, issuer, base=110)
+    batch_sign.sign_builders(issues + moves, [(issuer,)] * 3 + [(key,)] * 3)
+    stxs = [b.to_signed_transaction(check_sufficient_signatures=False)
+            for b in moves]
+    back = deserialize_corpus(serialize_corpus(stxs))
+    assert [serialize(s).bytes for s in back] == [
+        serialize(s).bytes for s in stxs]

@@ -73,7 +73,7 @@ def test_raft_bench_section_emits_replication_stamps(tmp_path, monkeypatch,
                "raft": _real_group_commit_stamp(tmp_path),
                "transport": _burst_transport_stats()}
 
-    def fake_sweep(rates=(30.0, 90.0, 150.0), n_tx=250, **kw):
+    def fake_sweep(rates=(60.0, 240.0, 720.0, 1800.0), n_tx=250, **kw):
         result = types.SimpleNamespace(p50_ms=5.0, p90_ms=9.0, p99_ms=20.0,
                                        tx_per_sec=30.0, committed=n_tx)
         return SweepResult(results={r: result for r in rates},
@@ -107,8 +107,9 @@ def test_raft_bench_section_emits_replication_stamps(tmp_path, monkeypatch,
     assert member_stamp["raft_role"] == "leader"
     assert member_stamp["raft"]["append_frames"] == 0  # no peers: no wire
     assert member_stamp["transport"]["outbox_bursts"] == 1
-    # And the latency table is intact next to them.
-    assert section["rates"]["30_tx_s"]["p99_ms"] == 20.0
+    # And the latency table is intact next to them (first rung of the
+    # round-15 ladder — the vectorized ingest plane raised the defaults).
+    assert section["rates"]["60_tx_s"]["p99_ms"] == 20.0
 
 
 def test_replication_summary_prefers_leader_then_busiest(tmp_path):

@@ -27,6 +27,7 @@ def _stub_phases(monkeypatch):
                  "bench_shard_scaling",  # ditto: boots up to 4 raft groups
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
                  "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
+                 "bench_ingest_sweep",  # ditto: builder + replay workers
                  "bench_reshard",  # ditto: live split + merge in-process nets
                  "bench_durability",  # ditto: a bitrot chaos soak + fsck
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
@@ -73,6 +74,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # host-only path asserts it separately; schema parity both ways.
     assert report["baseline_configs"]["slo_sweep"] == {
         "stub": "bench_slo_sweep"}
+    # The ingest-plane capability ladder (round 15) rides the device phase
+    # path too — the host-only path asserts it separately.
+    assert report["baseline_configs"]["ingest_sweep"] == {
+        "stub": "bench_ingest_sweep"}
     # The live-reshard section (round 13) rides the device phase path —
     # the host-only path asserts it separately; schema parity both ways.
     assert report["baseline_configs"]["reshard"] == {
@@ -142,6 +147,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_multichip_scaling"}
     assert report["baseline_configs"]["slo_sweep"] == {
         "stub": "bench_slo_sweep"}
+    assert report["baseline_configs"]["ingest_sweep"] == {
+        "stub": "bench_ingest_sweep"}
     assert report["baseline_configs"]["reshard"] == {
         "stub": "bench_reshard"}
     assert report["baseline_configs"]["raft_validating_3node"] == {
@@ -507,6 +514,100 @@ def test_slo_sweep_report_contract(monkeypatch):
     assert cal["saturation_rate"] == 240.0
     assert cal["interactive_rate"] > 0 and cal["bulk_rate"] > 0
     assert miss["calibration"]["met_slo"] is False
+
+
+def _fake_ingest_row(rate, achieved=None, exactly_once=True):
+    return {"offered_tx_s": float(rate),
+            "achieved_tx_s": achieved if achieved is not None else rate * 0.8,
+            "requested": 2000, "committed": 2000, "rejected": 0,
+            "duration_s": 2.0, "p50_ms": 5.0, "p99_ms": 40.0, "workers": 3,
+            "frames_per_tx": 1.4, "exactly_once": exactly_once,
+            "ingest": {"tx_built_per_s": 1800.0, "sigs_signed_per_s": 9000.0,
+                       "serialize_ms": 120.0, "prepare_s": 1.1,
+                       "bytes_written": 1 << 20, "sigs_signed": 4000,
+                       "cpu_s": 3.2, "load_prepare_s": 0.4}}
+
+
+def test_ingest_sweep_report_contract(monkeypatch):
+    """The ingest_sweep section's one-line-JSON contract (round 15): one
+    row per offered rate carrying the client-plane attribution block
+    (tx_built_per_s / sigs_signed_per_s / serialize_ms / cpu_s), the
+    frames-per-tx amortization, the exactly-once audit, the monotonic
+    offered-rate trend, per-sub-run error isolation, and the
+    first_bottleneck server-side attribution — identical schema on the
+    device and host-only phase paths (both registries call this one
+    function with no path-specific args)."""
+    from corda_tpu.tools import loadtest
+
+    calls = []
+
+    def fake_sweep(**kw):
+        calls.append(kw)
+        if kw.get("chaos"):
+            return loadtest.SweepResult(
+                results={1200.0: _fake_ingest_row(1200.0)},
+                node_stamps={})
+        return loadtest.SweepResult(
+            results={r: _fake_ingest_row(r) for r in kw["rates"]},
+            node_stamps={
+                "Raft0": {"busiest_stage": "fsync"},
+                "Raft1": {"busiest_stage": "fsync"},
+                "Raft2": {"busiest_stage": "verify"}})
+
+    monkeypatch.setattr(loadtest, "run_ingest_sweep", fake_sweep)
+    out = bench.bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0))
+
+    json.dumps(out)  # the one-line contract: fully serializable
+    # Main ladder clean, chaos leg armed with the lossy plan.
+    assert calls[0].get("chaos") is None and calls[1]["chaos"] == "lossy"
+    # The offered ladder is monotonic and every row carries its rate —
+    # the trend tooling reads the rows in rate order.
+    offered = [out["rates"][f"{r:g}_tx_s"]["offered_tx_s"]
+               for r in (1200.0, 3600.0, 10000.0)]
+    assert offered == sorted(offered)
+    assert out["offered_rates_tx_s"] == offered
+    # Client-plane attribution block rides every row.
+    row = out["rates"]["3600_tx_s"]
+    assert row["ingest"]["tx_built_per_s"] == 1800.0
+    assert row["ingest"]["sigs_signed_per_s"] == 9000.0
+    assert row["frames_per_tx"] == 1.4
+    # Headline keys, flat.
+    assert out["peak_offered_tx_s"] == 10000.0
+    assert out["peak_achieved_tx_s"] == 8000.0
+    assert out["exactly_once_all"] is True
+    # Server-side attribution: the majority busiest stage across members.
+    assert out["first_bottleneck"] == "fsync"
+    # Chaos leg verdict: exactly-once held under the lossy plan.
+    assert out["chaos"]["plan"] == "lossy"
+    assert out["chaos"]["exactly_once"] is True
+
+
+def test_ingest_sweep_report_isolates_subrun_errors(monkeypatch):
+    """One failed rate (dead worker, timeout) records an error row and the
+    later rates still report; headline aggregates come from the rates that
+    finished — and a chaos-leg crash costs only the chaos key."""
+    from corda_tpu.tools import loadtest
+
+    def fake_sweep(**kw):
+        if kw.get("chaos"):
+            raise RuntimeError("worker died mid-replay")
+        return loadtest.SweepResult(
+            results={
+                1200.0: _fake_ingest_row(1200.0),
+                3600.0: {"error": "TimeoutError: replay@3600 stalled",
+                         "offered_tx_s": 3600.0},
+                10000.0: _fake_ingest_row(10000.0)},
+            node_stamps={})
+
+    monkeypatch.setattr(loadtest, "run_ingest_sweep", fake_sweep)
+    out = bench.bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0))
+    json.dumps(out)
+    assert "TimeoutError" in out["rates"]["3600_tx_s"]["error"]
+    assert out["rates"]["10000_tx_s"]["committed"] == 2000
+    assert out["peak_achieved_tx_s"] == 8000.0
+    assert out["exactly_once_all"] is False  # an errored rate is not audited
+    assert out["first_bottleneck"] is None  # no stamps: honest null
+    assert "error" in out["chaos"]
 
 
 def _fake_reshard_result(**over):

@@ -383,7 +383,7 @@ def test_inmem_transport_stats_schema_parity(net):
         "outbox_max_burst", "outbox_burst_avg", "bridge_flushes",
         "bridge_flush_frames", "bridge_max_flush", "bridge_flush_avg",
         "redeliveries", "stale_resends", "poison_pending", "poison_drops",
-        "poison_retry_limit",
+        "poison_retry_limit", "frames_sent_total",
     }
     assert set(stats) == expected
     assert stats["redeliveries"] == 0

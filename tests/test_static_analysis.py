@@ -52,6 +52,18 @@ class TestTreeGate:
         report = analyze_paths([TREE])
         assert len(report.suppressed) >= 15
 
+    def test_ingest_hot_path_is_in_scope_and_clean(self):
+        # Round 15: the vectorized ingest plane is the highest-frequency
+        # client-side loop in the tree — pin it in-scope explicitly so a
+        # future exclude-list edit can't silently drop it from the gate
+        # (no per-item jit/wallclock/silent-except regressions).
+        report = analyze_paths([TREE / "tools" / "ingest.py",
+                                TREE / "crypto" / "batch_sign.py",
+                                TREE / "tools" / "loadgen.py"])
+        assert report.checked_files == 3
+        rendered = "\n".join(f.render() for f in report.findings)
+        assert report.clean, f"ingest-plane findings:\n{rendered}"
+
     def test_checked_in_baseline_entries_are_live_files_with_reasons(self):
         # The baseline shrinks monotonically (round 12 resolved the last
         # two entries at source, so empty is the healthy end state); any
