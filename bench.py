@@ -830,7 +830,7 @@ def bench_slo_sweep(rates=(120.0, 240.0, 480.0), n_tx=240, width=4,
                     clients=2, interactive_frac=0.25, slo_ms=250.0,
                     queue_watermark=48, flagship_tx_s=40.0,
                     notary="simple", verifier="cpu", notary_device="cpu",
-                    sidecar=False):
+                    sidecar=False, flight_dir=None):
     """The QoS plane's SLO section (round 12, ROADMAP open item 4): the
     mixed-lane offered-load sweep run TWICE over the same rates — once
     with the plane armed ([qos] enabled on every node: lane-ordered SMM
@@ -868,14 +868,30 @@ def bench_slo_sweep(rates=(120.0, 240.0, 480.0), n_tx=240, width=4,
            "queue_watermark": queue_watermark,
            "verifier": verifier, "notary_device": notary_device,
            "rates_tx_s": list(rates)}
+    # Flight recorder (obs/telemetry.py): the armed sweep runs with the
+    # driver-side recorder on — if any rung breaches the interactive SLO
+    # the breaching window dumps exactly one artifact here, and the
+    # report says where. (The baseline sweep runs unarmed: it EXISTS to
+    # collapse, dumping its expected breach would be noise.)
+    import tempfile as _tempfile
+
+    if flight_dir is None:
+        flight_dir = _tempfile.mkdtemp(prefix="corda-tpu-flight-")
     armed = run_slo_sweep(
         rates=rates, n_tx=n_tx, width=width, clients=clients,
         interactive_frac=interactive_frac, slo_ms=slo_ms,
         queue_watermark=queue_watermark, notary=notary, verifier=verifier,
-        notary_device=notary_device, sidecar=sidecar, qos=True)
+        notary_device=notary_device, sidecar=sidecar, qos=True,
+        flight_dir=flight_dir)
     out["qos"] = _lane_stats(armed)
     out["member_qos"] = armed.qos
     out["sidecar"] = armed.sidecar
+    out["flight"] = {"dir": flight_dir,
+                     "artifacts": getattr(armed, "flight", None) or []}
+    # Cluster telemetry fold (obs/export.collect_cluster): the merged
+    # per-phase counters across members — round_breakdown at sweep scope.
+    out["cluster_telemetry"] = (getattr(armed, "telemetry", None)
+                                or {}).get("merged")
     baseline = run_slo_sweep(
         rates=rates, n_tx=n_tx, width=width, clients=clients,
         interactive_frac=interactive_frac, slo_ms=slo_ms,
@@ -916,6 +932,54 @@ def bench_slo_sweep(rates=(120.0, 240.0, 480.0), n_tx=240, width=4,
     except Exception as e:
         out["calibration"] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def bench_telemetry(n_tx=80):
+    """The always-on telemetry plane's own section (round 16): run the
+    in-process loadtest against a FRESH registry and report what the
+    plane measured about it — the round profiler's phase breakdown (the
+    block that decomposes the ingest sweep's ``first_bottleneck =
+    "rounds"`` verdict into poll/verify_wait/seal/replicate/apply/reply
+    shares), plus a self-check that the Prometheus exposition the node
+    and sidecar endpoints serve round-trips through the parser with
+    every registered metric present. Host-only safe by construction:
+    nothing here touches a device — which is exactly the claim
+    ("always-on" must mean on THIS path too)."""
+    from corda_tpu.obs import telemetry as _tm
+    from corda_tpu.obs.export import parse_prometheus, render_prometheus
+    from corda_tpu.tools.loadtest import run_loadtest
+
+    reg = _tm.ACTIVE if _tm.ACTIVE is not None else _tm.arm()
+    reg.reset()
+    res = run_loadtest(n_tx=n_tx, notary="simple")
+    c = reg.snapshot()["counters"]
+    rounds = int(c["rounds_total"])
+    wall = c["round_wall_seconds_total"]
+    rp = {p: c[f"round_phase_{p}_seconds_total"] for p in _tm.ROUND_PHASES}
+    breakdown = _tm.format_breakdown(rp | {"wall": wall, "rounds": rounds})
+    coverage = (breakdown or {}).get("coverage")
+    text = render_prometheus(reg)
+    parsed = parse_prometheus(text)
+    return {
+        "harness": "in-process",
+        "n_tx": n_tx,
+        "committed": res.tx_committed,
+        "tx_per_sec": res.tx_per_sec,
+        # The acceptance bound: named sub-phases must attribute >= 90%
+        # of measured round wall time (measured here across BOTH
+        # in-process nodes — client and notary share the registry).
+        "round_breakdown": breakdown,
+        "breakdown_ok": bool(coverage is not None and coverage >= 0.9),
+        # /metrics validity: every registered series present and parseable.
+        "prometheus_bytes": len(text),
+        "prometheus_ok": bool(
+            set(parsed["counters"]) == set(_tm.COUNTER_NAMES)
+            and set(parsed["histograms"]) == set(_tm.HISTOGRAM_NAMES)),
+        "flows_started": int(c["flows_started_total"]),
+        "flows_completed": int(c["flows_completed_total"]),
+        "verify_batches": int(c["verify_batches_total"]),
+        "verify_sigs": int(c["verify_sigs_total"]),
+    }
 
 
 def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
@@ -1644,6 +1708,9 @@ def _run_host_only_phases(report: dict,
             # (client build/sign + transport amortization, notary on host
             # crypto) — the host-only run measures the identical section.
             ("ingest_sweep", bench_ingest_sweep),
+            # Always-on telemetry: round_breakdown coverage + Prometheus
+            # round-trip over an in-process loadtest — pure host path.
+            ("telemetry", bench_telemetry),
             ("shard_scaling", bench_shard_scaling),
             # Group count doubles mid-sweep under the lossy reshard plan;
             # the contract is exactly_once + a bounded p99 blip.
@@ -1868,6 +1935,10 @@ def _run_phases(report: dict) -> None:
                      # the first server-side stage it saturates) — the
                      # device never sits in the driven path here.
                      ("ingest_sweep", bench_ingest_sweep),
+                     # Telemetry plane: round profiler coverage + the
+                     # Prometheus render/parse contract, host path on
+                     # both runs (the claim is attribution, not kernels).
+                     ("telemetry", bench_telemetry),
                      ("shard_scaling", bench_shard_scaling),
                      # Group count doubles mid-sweep under the lossy
                      # reshard plan; exactly_once + a bounded p99 blip.

@@ -41,15 +41,18 @@ def _last_attr(dotted: str) -> str:
 
 class _Imports:
     """Module-alias table for one file: which local names refer to the
-    ``time`` / ``datetime`` / obs ``trace`` modules, and which bare names
-    are from-imported clock functions."""
+    ``time`` / ``datetime`` / obs ``trace`` / obs ``telemetry`` modules,
+    and which bare names are from-imported clock functions."""
 
     def __init__(self, tree: ast.AST):
         self.time_aliases: set[str] = set()
         self.datetime_aliases: set[str] = set()
         self.obs_trace_aliases: set[str] = set()
+        self.telemetry_aliases: set[str] = set()
         self.clock_names: dict[str, str] = {}   # local name -> origin fn
         self.record_names: set[str] = set()     # from obs.trace import record
+        # local name -> "inc" | "observe"  (from obs.telemetry import ...)
+        self.metric_fn_names: dict[str, str] = {}
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -67,12 +70,18 @@ class _Imports:
                     for a in node.names:
                         if a.name in ("datetime", "date"):
                             self.datetime_aliases.add(a.asname or a.name)
-                elif mod.endswith("obs") or mod.endswith("obs.trace"):
+                elif mod.endswith("obs") or mod.endswith("obs.trace") \
+                        or mod.endswith("obs.telemetry"):
                     for a in node.names:
                         if a.name == "trace":
                             self.obs_trace_aliases.add(a.asname or a.name)
+                        elif a.name == "telemetry":
+                            self.telemetry_aliases.add(a.asname or a.name)
                         elif a.name == "record" and mod.endswith("trace"):
                             self.record_names.add(a.asname or a.name)
+                        elif a.name in ("inc", "observe") and \
+                                mod.endswith("telemetry"):
+                            self.metric_fn_names[a.asname or a.name] = a.name
 
 
 _EPOCH_ATTRS = ("time", "time_ns")
@@ -552,21 +561,31 @@ class TraceStageRegistry(Rule):
     span recorded under an unregistered name silently vanishes from the
     bench breakdown (no error — a missing stage). Every literal span name
     passed to ``_obs.record(...)`` must come from the obs stage registry
-    (corda_tpu/obs/stages.py)."""
+    (corda_tpu/obs/stages.py). The telemetry plane has the same failure
+    shape with the opposite sign: ``_tm.inc``/``_tm.observe`` on a name
+    the registry never pre-interned RAISES at runtime — possibly only on
+    a rare error path — so literal metric names must come from
+    obs/telemetry.py's single-source-of-truth name registry too."""
 
     name = "trace-stage-registry"
-    contract = ("every recorded span name is registered in "
-                "obs/stages.py so stage_breakdown never silently drops "
-                "a stage")
-    hint = ("register the name in corda_tpu/obs/stages.py (and give it a "
-            "slot in STAGES if it is a breakdown stage), or reuse an "
-            "existing registered name")
+    contract = ("every recorded span name is registered in obs/stages.py "
+                "and every telemetry counter/histogram name in "
+                "obs/telemetry.py, so breakdowns never silently drop a "
+                "stage and metric updates never raise on a rare path")
+    hint = ("register the name in corda_tpu/obs/stages.py (breakdown "
+            "stages get a slot in STAGES) or in obs/telemetry.py's "
+            "COUNTER_NAMES/HISTOGRAM_NAMES, or reuse a registered name")
     exclude = ("obs/", "analysis/")
 
     def _registry(self):
         from ..obs import stages
 
         return stages.SPAN_NAMES, stages.SPAN_NAME_PREFIXES
+
+    def _metric_registry(self):
+        from ..obs import telemetry
+
+        return telemetry.METRIC_NAMES
 
     def _is_record_call(self, call: ast.Call, imports: _Imports) -> bool:
         func = call.func
@@ -578,15 +597,42 @@ class TraceStageRegistry(Rule):
         root = dotted.split(".", 1)[0]
         return root in imports.obs_trace_aliases
 
+    def _is_metric_call(self, call: ast.Call, imports: _Imports) -> bool:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id in imports.metric_fn_names
+        dotted = _dotted(func)
+        if _last_attr(dotted) not in ("inc", "observe"):
+            return False
+        root = dotted.split(".", 1)[0]
+        return root in imports.telemetry_aliases
+
     def check(self, ctx: FileContext) -> list[Finding]:
         imports = _Imports(ctx.tree)
-        if not imports.obs_trace_aliases and not imports.record_names:
+        track_spans = bool(imports.obs_trace_aliases or imports.record_names)
+        track_metrics = bool(imports.telemetry_aliases
+                             or imports.metric_fn_names)
+        if not track_spans and not track_metrics:
             return []
         names, prefixes = self._registry()
         out: list[Finding] = []
         for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call) or \
-                    not self._is_record_call(node, imports):
+            if not isinstance(node, ast.Call):
+                continue
+            if track_metrics and self._is_metric_call(node, imports):
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value not in self._metric_registry():
+                    out.append(ctx.finding(
+                        self, arg,
+                        f"metric name {arg.value!r} is not pre-interned in "
+                        "obs/telemetry.py — inc/observe raises ValueError "
+                        "here at runtime"))
+                continue
+            if not track_spans or not self._is_record_call(node, imports):
                 continue
             if not node.args:
                 continue

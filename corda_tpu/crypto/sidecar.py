@@ -58,6 +58,7 @@ host:port), little-endian throughout:
   OP_VERIFY  reply: u8(op) u32(req_id) u8(status) u8(tier)
                     f32(wait_s) f32(verify_s)  u8 ok[n]     (tier: 1=device)
   OP_STATS   reply: u8(op) u32(req_id) u8(status)  json(stats) utf-8
+  OP_METRICS reply: u8(op) u32(req_id) u8(status)  prometheus text utf-8
   OP_PING    reply: u8(op) u32(req_id) u8(status)
 Only well-formed ed25519 jobs ride the fixed-width arrays; the client
 rejects wrong-length keys/sigs locally (same semantics as the kernel path:
@@ -85,6 +86,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..obs import telemetry as _tm
 from .provider import VerifyJob, make_verifier
 
 OP_VERIFY = 1
@@ -97,6 +99,10 @@ OP_PING = 3
 # rejects it loudly (unknown op drops the connection, the client degrades
 # to its host tier) instead of silently mis-scheduling.
 OP_VERIFY_QOS = 4
+# Prometheus text exposition of this process's telemetry registry
+# (obs/export.py render): the sidecar's /metrics — same framing as
+# OP_STATS with a text body instead of JSON.
+OP_METRICS = 5
 
 STATUS_OK = 0
 STATUS_ERR = 1
@@ -536,6 +542,8 @@ class SidecarServer:
                             self.qos_interactive_requests += 1
                         elif pend.lane == LANE_CODE_BULK:
                             self.qos_bulk_requests += 1
+                    if _tm.ACTIVE is not None:
+                        _tm.inc("sidecar_requests_total")
                     with self._cv:
                         self._pending.append(pend)
                         self._cv.notify_all()
@@ -543,6 +551,12 @@ class SidecarServer:
                     body = json.dumps(self.stats()).encode()
                     client.reply(
                         _REPLY_HDR.pack(OP_STATS, req_id, STATUS_OK) + body)
+                elif op == OP_METRICS:
+                    from ..obs.export import render_prometheus
+
+                    client.reply(
+                        _REPLY_HDR.pack(OP_METRICS, req_id, STATUS_OK)
+                        + render_prometheus().encode())
                 elif op == OP_PING:
                     client.reply(_REPLY_HDR.pack(OP_PING, req_id, STATUS_OK))
                 else:
@@ -695,6 +709,10 @@ class SidecarServer:
             verify_s = time.perf_counter() - t0
             tier = 1 if (getattr(self.verifier, "device_batches", 0)
                          or 0) > before_dev else 0
+            if _tm.ACTIVE is not None:
+                _tm.inc("sidecar_batches_total")
+                _tm.inc("sidecar_sigs_total", len(jobs))
+                _tm.observe("sidecar_batch_sigs", len(jobs))
             with self._lock:
                 self.batches += 1
                 self.sigs += len(jobs)

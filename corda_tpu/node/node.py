@@ -26,6 +26,7 @@ import time
 from collections import deque
 
 from ..flows.data_vending import install_data_vending
+from ..obs import telemetry as _tm
 from ..obs import trace as _obs
 from ..qos import context as _qos
 from ..testing import faults as _faults
@@ -577,6 +578,18 @@ class Node:
                               "services": 0.0, "verify": 0.0,
                               "verify_drain": 0.0, "verify_submit": 0.0,
                               "checkpoint": 0.0, "commit": 0.0, "rounds": 0})
+        # Round profiler (obs/telemetry.py ROUND_PHASES): the always-on
+        # breakdown that attributes round wall time to named sub-phases —
+        # round_stage_s answers "which code block", this answers "which
+        # pipeline phase" (and the raft segment is split seal/replicate/
+        # apply from the leader's own phase accumulators).
+        rp = self.smm.metrics.setdefault(
+            "round_phase_s", {"poll": 0.0, "verify_wait": 0.0, "seal": 0.0,
+                              "replicate": 0.0, "apply": 0.0, "reply": 0.0,
+                              "wall": 0.0, "rounds": 0})
+        rm = self.raft_member
+        raft_pre = ((rm.phase_s["seal"], rm.phase_s["replicate"],
+                     rm.phase_s["apply"]) if rm is not None else None)
         t = time.perf_counter
         t_pre = t()
         try:
@@ -650,7 +663,7 @@ class Node:
                 stages["verify_submit"] += t5 - t4
                 stages["checkpoint"] += t6 - t5
                 stages["rounds"] += 1
-        except BaseException:
+        except BaseException as exc:
             # The round rolled back: its deferred ACKs must not be sent
             # (senders redeliver) and in-memory flow state is now AHEAD of
             # durable state — the process should be restarted; recovery
@@ -658,8 +671,46 @@ class Node:
             abort = getattr(self.messaging, "abort_round", None)
             if abort is not None:
                 abort()
+            if isinstance(exc, Exception):
+                # Crash dump (flight recorder, latched + never-raising):
+                # the last window of metric deltas and spans, captured at
+                # the failure, not at the post-restart repro attempt.
+                # Shutdown paths (KeyboardInterrupt/SystemExit) are not
+                # crashes and dump nothing.
+                _tm.flight_trigger("crash", extra={
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "node": self.config.name})
             raise
         stages["commit"] += t() - t6  # db.batch() exit = the round fsync
+        t_end = t()
+        rp["rounds"] += 1
+        rp["wall"] += t_end - t_pre
+        poll = t1 - t_pre
+        verify_wait = (t3d - t3) + (t5 - t4)
+        apply_s = t3 - t2  # service polling applies committed work
+        reply = (t6 - t5) + (t_end - t6)  # checkpoint/push + round fsync
+        seal_d = repl_d = 0.0
+        if raft_pre is not None:
+            seal_d = rm.phase_s["seal"] - raft_pre[0]
+            repl_d = rm.phase_s["replicate"] - raft_pre[1]
+            raft_apply_d = rm.phase_s["apply"] - raft_pre[2]
+            apply_s += raft_apply_d
+            # Whatever of the round's raft segment the leader phases did
+            # not claim (tick bookkeeping, follower forwarding, election
+            # checks) moves replication state — attribute it there rather
+            # than inventing an "other" phase.
+            repl_d += max(0.0, ((t2 - t1) + (t4 - t3d))
+                          - seal_d - repl_d - raft_apply_d)
+        rp["poll"] += poll
+        rp["verify_wait"] += verify_wait
+        rp["seal"] += seal_d
+        rp["replicate"] += repl_d
+        rp["apply"] += apply_s
+        rp["reply"] += reply
+        if _tm.ACTIVE is not None:
+            _tm.observe_round(t_end - t_pre, {
+                "poll": poll, "verify_wait": verify_wait, "seal": seal_d,
+                "replicate": repl_d, "apply": apply_s, "reply": reply})
         flush = getattr(self.messaging, "flush_round", None)
         if flush is not None:
             flush()
@@ -685,7 +736,13 @@ class Node:
                 for k, v in self.smm.metrics.items()}
         snap["ts"] = round(time.time(), 3)
         snap["flows_in_flight"] = self.smm.in_flight_count
+        # The formatted round profile travels with every history sample so
+        # the time-series shows phase SHARES drifting, not just raw sums.
+        snap["round_breakdown"] = _tm.format_breakdown(
+            self.smm.metrics.get("round_phase_s"))
         self.metrics_history.append(snap)  # deque(maxlen=KEEP) self-trims
+        if _tm.ACTIVE is not None and _tm.ACTIVE.flight is not None:
+            _tm.ACTIVE.flight.tick(_tm.ACTIVE.snapshot()["counters"])
 
     def run_forever(self) -> None:
         while True:
@@ -831,6 +888,10 @@ def main(argv: list[str] | None = None) -> int:
     # QoS plane: normally armed from [qos] in the config (Node.__init__);
     # CORDA_TPU_QOS arms it env-wise for ad-hoc runs. A no-op when unset.
     _qos.arm_from_env(config.name)
+    # Flight recorder (obs/telemetry.py): CORDA_TPU_FLIGHT_DIR=<dir> arms
+    # auto-dumps for this process (fsck failure, crash, overload spike).
+    # Attached BEFORE the fsck gate so a corrupt boot is itself captured.
+    _tm.ensure_flight(node=config.name)
     # Boot fsck: verify the store's integrity frames before serving.
     # Log-only here — corruption found at boot is reported loudly and then
     # handled by the online planes (raft heal / checkpoint quarantine);
@@ -846,6 +907,11 @@ def main(argv: list[str] | None = None) -> int:
                 "self-healing will repair what consensus can; run "
                 "corda_tpu.tools.fsck --repair for the rest",
                 report["corrupt"], report["stores"])
+            # Capture the corrupt-boot evidence at the moment it was
+            # found (latched; a crash-restart loop dumps once).
+            _tm.flight_trigger("fsck_failure", extra={
+                k: report[k] for k in ("path", "stores", "clean",
+                                       "corrupt", "scanned")})
     except Exception:
         # Never block boot on the checker itself (e.g. a locked store
         # during a crash-restart race) — the online scrubber covers it.

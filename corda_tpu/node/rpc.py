@@ -26,6 +26,7 @@ from typing import Any
 
 from ..crypto.hashes import SecureHash
 from ..flows.api import flow_registry
+from ..obs import telemetry as _tm
 from ..obs import trace as _obs
 from ..qos import context as _qos
 from ..serialization.codec import deserialize, register, serialize
@@ -270,6 +271,31 @@ class NodeRpcOps:
                 smm.verifier, "reprobes_ok", None),
             "verify_device_reprobes_failed": getattr(
                 smm.verifier, "reprobes_failed", None),
+            # Round profiler (obs/telemetry.py): the always-on per-phase
+            # attribution of round wall time — the block that explains a
+            # first_bottleneck of "rounds". None before the first round.
+            "round_breakdown": _tm.format_breakdown(
+                smm.metrics.get("round_phase_s")),
+            # Process-global telemetry registry counters (the histogram
+            # halves export via /metrics and telemetry_snapshot — counters
+            # alone keep this stamp grep-sized). None only if a test
+            # disarmed the always-on registry.
+            "telemetry": ((_tm.snapshot() or {}).get("counters")
+                          if _tm.ACTIVE is not None else None),
+        }
+
+    def telemetry_snapshot(self) -> dict:
+        """The full telemetry registry (counters + histograms) for the
+        driver-side cluster collector (obs/export.py collect_cluster) —
+        the RPC twin of GET /metrics, JSON instead of exposition text so
+        the collector merges exact sparse buckets, not parsed ones."""
+        return {
+            "node": self._node.config.name,
+            "armed": _tm.ACTIVE is not None,
+            "snapshot": _tm.snapshot(),
+            "flight": (_tm.ACTIVE.flight.stats()
+                       if _tm.ACTIVE is not None
+                       and _tm.ACTIVE.flight is not None else None),
         }
 
     def trace_snapshot(self) -> dict:

@@ -46,6 +46,7 @@ from typing import Any, Callable, Sequence
 
 from ...crypto.hashes import SecureHash
 from ...crypto.party import Party
+from ...obs import telemetry as _tm
 from ...obs import trace as _obs
 from ...qos import context as _qos
 from ...serialization.codec import deserialize, register, serialize
@@ -549,6 +550,12 @@ class RaftMember:
             "leader_stepdowns": 0,  # leaderships ceded to corruption/disk
             "disk_degraded": 0,     # disk-full write failures absorbed
         }
+        # Leader seal-path phase accumulators (seconds), read as per-round
+        # deltas by node.run_once to split its raft segment into the
+        # round_breakdown's seal / replicate / apply phases. Unconditional
+        # and unlocked: three perf_counter reads per flush, single
+        # (node-loop) writer.
+        self.phase_s = {"seal": 0.0, "replicate": 0.0, "apply": 0.0}
         messaging.add_message_handler(RAFT_TOPIC, 0, self._on_message)
 
     # -- persistence -------------------------------------------------------
@@ -779,10 +786,18 @@ class RaftMember:
         if self.role != "leader":
             self._flush_forwards()
             return
+        t = _time.perf_counter
+        t0 = t()
         self._seal_batch()
         self._append_dirty = False
+        t1 = t()
         self._broadcast_append()
+        t2 = t()
         self._advance_commit()
+        ph = self.phase_s
+        ph["seal"] += t1 - t0
+        ph["replicate"] += t2 - t1
+        ph["apply"] += t() - t2
 
     def _seal_batch(self) -> None:
         """Merge the round's buffered commands into ONE log entry (one
@@ -815,6 +830,10 @@ class RaftMember:
                 self.metrics["group_commits"] += 1
                 self.metrics["group_commands"] += len(cmds)
                 self._log_append(last_idx + 1, self.term, PutAllBatch(cmds))
+            if _tm.ACTIVE is not None:
+                _tm.inc("raft_seals_total")
+                _tm.inc("raft_seal_entries_total", len(cmds))
+                _tm.observe("raft_seal_entries", len(cmds))
         except sqlite3.OperationalError as e:
             if not _integrity.is_disk_full(e):
                 raise
@@ -1607,6 +1626,9 @@ class RaftMember:
             "replication_rtt_ms_avg": (
                 round(1e3 * m["replication_rtt_s"] / rtt_n, 3)
                 if rtt_n else None),
+            # Leader seal-path wall time by phase (the round profiler's
+            # seal/replicate/apply split, summed over every flush).
+            "phase_s": {k: round(v, 6) for k, v in self.phase_s.items()},
         }
 
 

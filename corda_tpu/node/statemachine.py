@@ -55,6 +55,7 @@ from ..flows.api import (
     VerifyTxRequest,
     flow_registry,
 )
+from ..obs import telemetry as _tm
 from ..obs import trace as _obs
 from ..qos import context as _qos
 from ..serialization.codec import (
@@ -882,6 +883,8 @@ class StateMachineManager:
         # collide with checkpoint-restored flows.
         run_id = os.urandom(16)
         fsm = FlowStateMachine(self, logic, run_id)
+        if _tm.ACTIVE is not None:
+            _tm.inc("flows_started_total")
         if _obs.ACTIVE is not None:
             # A client-started flow roots a NEW trace; everything downstream
             # (sessions, verify batches, raft commits) stitches under it.
@@ -940,6 +943,8 @@ class StateMachineManager:
         timing["count"] += 1
         timing["total_ms"] = round(timing["total_ms"] + duration_ms, 3)
         timing["max_ms"] = round(max(timing["max_ms"], duration_ms), 3)
+        if _tm.ACTIVE is not None:
+            _tm.inc("flows_completed_total")
 
     # -- checkpoint & restore ---------------------------------------------
 
@@ -1328,6 +1333,10 @@ class StateMachineManager:
         ok = self.verifier.verify_batch(jobs) if jobs else []
         self.metrics["verify_batches"] += 1
         self.metrics["verify_sigs"] += len(jobs)
+        if _tm.ACTIVE is not None:
+            _tm.inc("verify_batches_total")
+            _tm.inc("verify_sigs_total", len(jobs))
+            _tm.observe("verify_batch_sigs", len(jobs))
         self._deliver_verify_results(spans, ok)
 
     def _record_verify_wait(self, batch) -> None:
@@ -1425,6 +1434,10 @@ class StateMachineManager:
         if _obs.ACTIVE is not None:
             self._record_verify_wait(batch)
         jobs, spans = self._build_verify_jobs(batch)
+        if _tm.ACTIVE is not None:
+            _tm.inc("verify_batches_total")
+            _tm.inc("verify_sigs_total", len(jobs))
+            _tm.observe("verify_batch_sigs", len(jobs))
         self.async_verify.submit(jobs, spans)
         return len(jobs)
 

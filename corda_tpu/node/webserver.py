@@ -9,8 +9,12 @@ endpoints): a small threaded HTTP server exposing
   GET  /api/status                 -> {"name", "address", "flows_in_flight"}
   GET  /api/metrics                -> the SMM metric registry + per-flow
                                       completion timings
-  GET  /api/metrics/history        -> bounded counters time-series (the
-                                      JMX/Jolokia capability, Node.kt:313)
+  GET  /api/metrics/history        -> bounded counters time-series, newest
+                                      first (the JMX/Jolokia capability,
+                                      Node.kt:313)
+  GET  /metrics                    -> the always-on telemetry registry in
+                                      Prometheus text exposition format
+                                      (obs/telemetry.py via obs/export.py)
   GET  /api/trace                  -> this node's span buffer (obs/trace.py)
                                       for the driver-side trace collector
   GET  /api/info                   -> identity + advertised services
@@ -87,7 +91,22 @@ class NodeWebServer:
         elif path == "/api/metrics/history":
             # Bounded time-series ring sampled by the run loop (the
             # JMX/Jolokia counters-over-time capability, Node.kt:313).
-            self._json(handler, list(node.metrics_history))
+            # Newest-first: a dashboard polling "what just happened"
+            # reads element 0, not element N, and a truncating client
+            # keeps the recent half.
+            self._json(handler, list(node.metrics_history)[::-1])
+        elif path == "/metrics":
+            # Prometheus text exposition (obs/export.py): the always-on
+            # telemetry registry — every registered counter/histogram,
+            # including series that have not fired yet.
+            from ..obs.export import CONTENT_TYPE, render_prometheus
+
+            body = render_prometheus().encode()
+            handler.send_response(200)
+            handler.send_header("Content-Type", CONTENT_TYPE)
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
         elif path == "/api/trace":
             # This node's span buffer (obs/trace.py), JSON-safe; the
             # driver-side collector merges many of these into one Chrome

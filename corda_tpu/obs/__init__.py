@@ -10,6 +10,14 @@ Split like testing/faults.py so the hot path stays cheap:
               trace-event / Perfetto JSON artifact and compute the per-stage
               p50/p99 breakdown (queue_wait / verify_wait / device_verify /
               raft_append / fsync / replication / reply).
+  telemetry.py  the ALWAYS-ON half: process-global counter/histogram
+              registry (armed at import, one attribute check when a test
+              disarms it), the round profiler feed (poll / verify_wait /
+              seal / replicate / apply / reply), and the flight recorder
+              that auto-dumps a JSON artifact on SLO breach, overload
+              spike, fsck failure, or crash.
+  export.py   Prometheus text exposition (GET /metrics, sidecar OP_METRICS)
+              + the cluster collector merging per-node registry snapshots.
 
 Everything here is stdlib-only on purpose: the transports and the state
 machine import `trace` at module load, so it must never pull in jax, the

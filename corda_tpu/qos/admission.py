@@ -31,6 +31,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..obs import telemetry as _tm
 from .context import LANE_BULK, LANE_INTERACTIVE
 
 __all__ = ["AdmissionController", "TokenBucket"]
@@ -38,6 +39,13 @@ __all__ = ["AdmissionController", "TokenBucket"]
 # Never tell a client to wait longer than this for one token; sustained
 # overload is paced by repeated shed/retry rounds, not one giant sleep.
 MAX_RETRY_AFTER_S = 2.0
+
+# OverloadedError-spike detection for the flight recorder: this many
+# sheds inside one sliding window triggers a (latched) "overload_spike"
+# dump — the moment the controller starts turning work away in bulk is
+# exactly the moment worth capturing, not reproducing.
+SPIKE_WINDOW_S = 5.0
+SPIKE_SHEDS = 50
 
 
 class TokenBucket:
@@ -94,6 +102,9 @@ class AdmissionController:
             "shed_bulk": 0,
             "watermark_sheds": 0,
         }
+        # Shed-spike sliding window (flight recorder trigger state).
+        self._spike_t0 = 0.0
+        self._spike_n = 0
 
     def admit(self, lane: str, queue_depth: int = 0) -> float | None:
         """None when admitted; otherwise the suggested client retry-after
@@ -106,15 +117,40 @@ class AdmissionController:
                     and queue_depth > self.queue_watermark):
                 self.counters["shed_bulk"] += 1
                 self.counters["watermark_sheds"] += 1
+                self._note_shed(queue_depth)
                 # Depth drains at commit pace, not token pace: a short,
                 # fixed pause is the honest hint.
                 return min(MAX_RETRY_AFTER_S,
                            max(0.05, bucket.retry_after_s()))
             if bucket.try_take():
                 self.counters[f"admitted_{lane}"] += 1
+                if _tm.ACTIVE is not None:
+                    _tm.inc("admission_admitted_total")
                 return None
             self.counters[f"shed_{lane}"] += 1
+            self._note_shed(queue_depth)
             return max(0.01, bucket.retry_after_s())
+
+    def _note_shed(self, queue_depth: int) -> None:
+        """Called under self._lock on every shed: count telemetry and
+        detect an OverloadedError spike (>= SPIKE_SHEDS sheds within
+        SPIKE_WINDOW_S) for the latched flight-recorder dump."""
+        if _tm.ACTIVE is None:
+            return
+        _tm.inc("admission_shed_total")
+        now = time.monotonic()
+        if now - self._spike_t0 > SPIKE_WINDOW_S:
+            self._spike_t0 = now
+            self._spike_n = 0
+        self._spike_n += 1
+        if self._spike_n == SPIKE_SHEDS:
+            # Latched inside the recorder: sustained overload dumps once.
+            # trigger never raises and the artifact write happens at most
+            # once per process, so doing it under the admission lock is a
+            # bounded, once-ever cost.
+            _tm.flight_trigger("overload_spike", extra={
+                "window_s": SPIKE_WINDOW_S, "sheds_in_window": self._spike_n,
+                "queue_depth": queue_depth, **self.counters})
 
     def reconfigure(self, interactive_rate: float | None = None,
                     interactive_burst: float | None = None,

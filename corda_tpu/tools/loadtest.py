@@ -939,6 +939,31 @@ class MultiProcessResult:
         return json.dumps(self.__dict__)
 
 
+# A member that ran fewer rounds than this has a stage breakdown made of
+# noise (a 2-sample stage winning "busiest" steered a whole sweep's
+# first_bottleneck verdict) — below it, attribution abstains.
+BUSIEST_STAGE_MIN_ROUNDS = 20
+
+
+def _busiest_stage(stage: dict | None) -> str | None:
+    """The round stage this member spent the most wall time in, guarded:
+
+    * abstains (None) below BUSIEST_STAGE_MIN_ROUNDS rounds — too few
+      samples to mean anything;
+    * excludes the "rounds" key, which is an integer COUNT riding in the
+      same dict as the float seconds (the unguarded ``max(stage,
+      key=stage.get)`` happily crowned it after ~200 rounds);
+    * breaks ties deterministically (alphabetically first of the maxima)
+      so two equal stages can't flap the sweep verdict between runs."""
+    stage = stage or {}
+    if stage.get("rounds", 0) < BUSIEST_STAGE_MIN_ROUNDS:
+        return None
+    timed = {k: v for k, v in stage.items() if k != "rounds"}
+    if not timed:
+        return None
+    return max(sorted(timed), key=timed.get)
+
+
 def _member_stamp(metrics: dict, device: str) -> dict:
     """One notary member's self-describing stamp from its node_metrics
     snapshot: verifier/backend/device identity, device-vs-host routing,
@@ -1010,9 +1035,13 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             "session_bursts": metrics.get("session_bursts"),
             "session_burst_frames": metrics.get("session_burst_frames"),
             # The round stage this member spent the most wall time in — the
-            # first SERVER-side bottleneck a saturating firehose exposes.
-            "busiest_stage": (max(stage, key=stage.get)
-                              if stage else None)}
+            # first SERVER-side bottleneck a saturating firehose exposes
+            # (min-sample guarded + tie-broken, see _busiest_stage).
+            "busiest_stage": _busiest_stage(stage),
+            # The round profiler's phase attribution (obs/telemetry.py):
+            # the block that decomposes a busiest_stage of "rounds"/"pump"
+            # into poll/verify_wait/seal/replicate/apply/reply shares.
+            "round_breakdown": metrics.get("round_breakdown")}
 
 
 def run_loadtest_multiprocess(
@@ -1363,6 +1392,12 @@ class SweepResult:
     # Per-member QoS plane + admission-controller stats (rpc node_metrics
     # "qos"/"admission") when the sweep ran with the plane armed.
     qos: dict | None = None
+    # Cluster telemetry fold (obs/export.collect_cluster over per-member
+    # telemetry_snapshot RPCs): per-node registries + the merged view.
+    telemetry: dict | None = None
+    # Flight-recorder artifact paths the sweep produced (slo_sweep with
+    # flight_dir set: the latched slo_breach dump); None when unarmed.
+    flight: list | None = None
 
     def __getitem__(self, rate):
         return self.results[rate]
@@ -1643,6 +1678,10 @@ def run_slo_sweep(
     sidecar_devices: int = 0,
     qos: bool = True,  # False: the SAME mixed-lane offered load through an
     # unarmed plane — the no-QoS baseline the SLO verdict compares against
+    flight_dir: str | None = None,  # arm the driver-side flight recorder:
+    # the first rate whose merged interactive p99 breaches slo_ms dumps
+    # ONE artifact (breaching window's per-rate metric deltas + member
+    # spans) into this directory
 ) -> SweepResult:
     """Mixed-lane open-loop sweep for the explicit p99 SLO verdict: at each
     offered load, every client process drives TWO concurrent firehoses —
@@ -1659,9 +1698,21 @@ def run_slo_sweep(
     bulk absorbs the overload as sheds. With ``qos=False`` the same load
     runs bit-identical to the pre-QoS tree and both lanes collapse
     together — the baseline."""
+    from ..obs import telemetry as _tm
     from ..testing.driver import driver
 
     base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-slo-"))
+    recorder = None
+    member_env = None
+    if flight_dir:
+        # Driver-side recorder: the sweep loop ticks it with per-rate lane
+        # summaries, so the breach artifact's window reads as "how the
+        # ladder climbed into the breach". Members get tracing armed so
+        # the artifact carries their spans; they do NOT get their own
+        # flight dir (exactly-one-artifact is the sweep's contract, and a
+        # member overload dump would race it).
+        recorder = _tm.FlightRecorder(str(flight_dir), node="slo-driver")
+        member_env = {"CORDA_TPU_TRACE": "1"}
 
     def _extra(v: str, sidecar_addr: str = "") -> str:
         out = (f'verifier = "{v}"\n'
@@ -1686,6 +1737,7 @@ def run_slo_sweep(
     results: dict = {}
     stamps: dict = {}
     qstats: dict = {}
+    tsnaps: dict = {}
     side_stats = None
     lanes = (("interactive", float(interactive_frac), float(slo_ms)),
              ("bulk", 1.0 - float(interactive_frac), 0.0))
@@ -1700,7 +1752,7 @@ def run_slo_sweep(
         members = _start_notary_processes(
             d, notary, cluster_size, _extra(verifier, side_addr),
             follower_extra=_extra("cpu", side_addr), device=notary_device,
-            rpc=True)
+            rpc=True, env_extra=member_env)
         member_rpcs = []
         for m in members:
             member_rpcs.append(m.rpc("demo", "s3cret", timeout=60.0))
@@ -1757,12 +1809,44 @@ def run_slo_sweep(
                 by_lane.setdefault(lane, []).append(v)
             results[rate] = {lane: _merge_firehose(vs)
                              for lane, vs in by_lane.items()}
+            if recorder is not None:
+                sample: dict = {"rate_tx_s": float(rate)}
+                for lane, fr in results[rate].items():
+                    sample[f"{lane}_p99_ms"] = fr.p99_ms
+                    sample[f"{lane}_tx_per_sec"] = fr.tx_per_sec
+                    sample[f"{lane}_committed"] = fr.committed
+                    sample[f"{lane}_shed"] = fr.shed
+                recorder.tick(sample)
+                inter = results[rate].get("interactive")
+                if inter is not None and inter.p99_ms > slo_ms:
+                    # SLO breach: dump once (the recorder latches on the
+                    # reason, so later breaching rungs add nothing) with
+                    # the breaching window's deltas, the members' span
+                    # buffers, and their telemetry counters AT the breach.
+                    spans: list = []
+                    counters: dict = {}
+                    for m, r in zip(members, member_rpcs):
+                        try:
+                            spans.extend(
+                                r.call("trace_snapshot").get("spans") or [])
+                            counters[m.name] = (
+                                (r.call("telemetry_snapshot").get("snapshot")
+                                 or {}).get("counters"))
+                        # lint: allow(no-silent-except) sweep tooling: a dead member costs its breach evidence, not the sweep; not a production verify/notarise path
+                        except Exception:
+                            pass
+                    recorder.trigger("slo_breach", extra={
+                        "rate_tx_s": float(rate), "slo_ms": float(slo_ms),
+                        "interactive_p99_ms": inter.p99_ms,
+                        "member_counters": counters}, spans=spans)
         for m, r in zip(members, member_rpcs):
             try:
                 metrics = r.call("node_metrics")
                 stamps[m.name] = _member_stamp(metrics, m.device)
                 qstats[m.name] = {"qos": metrics.get("qos"),
                                   "admission": metrics.get("admission")}
+                tsnaps[m.name] = r.call(
+                    "telemetry_snapshot").get("snapshot")
             # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep
@@ -1773,8 +1857,13 @@ def run_slo_sweep(
                 side_stats = fetch_sidecar_stats(side.address)
             except SidecarError:
                 side_stats = {"error": "sidecar unreachable at gather"}
+    from ..obs.export import collect_cluster
+
     return SweepResult(results=results, node_stamps=stamps,
-                       sidecar=side_stats, qos=qstats or None)
+                       sidecar=side_stats, qos=qstats or None,
+                       telemetry=collect_cluster(tsnaps) if tsnaps else None,
+                       flight=(sorted(recorder.dumped.values())
+                               if recorder is not None else None))
 
 
 _LOSSY_PLAN_TOML = """\

@@ -28,6 +28,7 @@ def _stub_phases(monkeypatch):
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
                  "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
                  "bench_ingest_sweep",  # ditto: builder + replay workers
+                 "bench_telemetry",  # ditto: an in-process loadtest round
                  "bench_reshard",  # ditto: live split + merge in-process nets
                  "bench_durability",  # ditto: a bitrot chaos soak + fsck
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
@@ -78,6 +79,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # path too — the host-only path asserts it separately.
     assert report["baseline_configs"]["ingest_sweep"] == {
         "stub": "bench_ingest_sweep"}
+    # The telemetry section (round 16) rides the device phase path — the
+    # host-only path asserts it separately; schema parity both ways.
+    assert report["baseline_configs"]["telemetry"] == {
+        "stub": "bench_telemetry"}
     # The live-reshard section (round 13) rides the device phase path —
     # the host-only path asserts it separately; schema parity both ways.
     assert report["baseline_configs"]["reshard"] == {
@@ -149,6 +154,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_slo_sweep"}
     assert report["baseline_configs"]["ingest_sweep"] == {
         "stub": "bench_ingest_sweep"}
+    assert report["baseline_configs"]["telemetry"] == {
+        "stub": "bench_telemetry"}
     assert report["baseline_configs"]["reshard"] == {
         "stub": "bench_reshard"}
     assert report["baseline_configs"]["raft_validating_3node"] == {
@@ -477,6 +484,14 @@ def test_slo_sweep_report_contract(monkeypatch):
     # Both runs happened, armed first, over the same rates.
     assert [kw["qos"] for kw in calls] == [True, False]
     assert calls[0]["rates"] == calls[1]["rates"] == (60.0, 240.0)
+    # Round 16: only the ARMED run gets the flight-recorder dump dir (the
+    # baseline exists to collapse — dumping its breach would be noise),
+    # and the section surfaces the dir + artifact list even when the
+    # sweep result predates the telemetry fields (getattr-compat).
+    assert calls[0]["flight_dir"] and "flight_dir" not in calls[1]
+    assert out["flight"]["dir"] == calls[0]["flight_dir"]
+    assert out["flight"]["artifacts"] == []
+    assert out["cluster_telemetry"] is None
     # Per-lane percentiles at every rate, both sections.
     assert out["qos"]["240_tx_s"]["interactive"]["p99_ms"] == 120.0
     assert out["qos"]["240_tx_s"]["bulk"]["shed"] == 35

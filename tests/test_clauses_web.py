@@ -196,7 +196,8 @@ class TestMonitoringBridge:
             history = json.load(
                 urllib.request.urlopen(f"{base}/api/metrics/history"))
             assert len(history) >= 2
-            assert history[-1]["ts"] >= history[0]["ts"]
+            # Round 16: the history endpoint serves newest-first.
+            assert history[0]["ts"] >= history[-1]["ts"]
             assert "verify_sigs" in history[-1]
         finally:
             node.stop()

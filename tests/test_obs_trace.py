@@ -344,7 +344,11 @@ def test_metrics_history_is_bounded_deque_and_served(tmp_path):
             history = json.load(resp)
         assert isinstance(history, list)
         assert len(history) == Node.METRICS_HISTORY_KEEP
-        assert history[-1] == {"t": Node.METRICS_HISTORY_KEEP + 9}
+        # Served newest-first: dashboards and flight-dump readers want the
+        # most recent sample at index 0 (the deque itself stays
+        # oldest-first append order).
+        assert history[0] == {"t": Node.METRICS_HISTORY_KEEP + 9}
+        assert history[-1] == {"t": 10}
     finally:
         node.stop()
 
@@ -376,6 +380,8 @@ def test_api_trace_serves_span_buffer(tmp_path):
 
 
 def test_inmem_transport_stats_schema_parity(net):
+    from corda_tpu.node.messaging.tcp import TcpMessaging
+
     node = net.create_node("StatsNode")
     stats = node.messaging.transport_stats()
     expected = {
@@ -387,3 +393,9 @@ def test_inmem_transport_stats_schema_parity(net):
     }
     assert set(stats) == expected
     assert stats["redeliveries"] == 0
+    # Real parity, not just the inmem side of it: a TcpMessaging instance
+    # (not started: no sockets, just counter state) must expose the exact
+    # same key set, so cluster collectors can merge stats without
+    # per-transport special cases.
+    tcp_stats = TcpMessaging().transport_stats()
+    assert set(tcp_stats) == set(stats) == expected

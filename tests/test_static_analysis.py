@@ -427,6 +427,52 @@ class TestTraceStageRegistry:
         assert set(stages.DIRECT_STAGES) <= set(stages.STAGES)
         assert set(stages.DERIVED_STAGES) <= set(stages.STAGES)
 
+    # Round 16: the rule also covers telemetry metric names — a typo'd
+    # inc()/observe() literal raises ValueError at runtime (possibly only
+    # on a rare error path), so it must go red at lint time.
+
+    def test_unregistered_telemetry_metric_goes_red(self):
+        src = (
+            "from ..obs import telemetry as _tm\n"
+            "def f():\n"
+            "    _tm.inc('verify_batchs_total')\n"
+            "    _tm.observe('round_wall_seconds', 0.1)\n"
+        )
+        report = analyze_source(src, "corda_tpu/node/x.py")
+        assert _rules(report).count("trace-stage-registry") == 1
+
+    def test_registered_telemetry_metric_names_are_clean(self):
+        src = (
+            "from ..obs import telemetry as _tm\n"
+            "from ..obs.telemetry import inc\n"
+            "def f(n):\n"
+            "    _tm.inc('verify_batches_total')\n"
+            "    _tm.observe('verify_batch_sigs', n)\n"
+            "    inc('rounds_total')\n"
+        )
+        report = analyze_source(src, "corda_tpu/node/x.py")
+        assert "trace-stage-registry" not in _rules(report)
+
+    def test_from_imported_inc_with_unknown_name_goes_red(self):
+        src = (
+            "from ..obs.telemetry import inc as _inc\n"
+            "def f():\n"
+            "    _inc('made_up_total')\n"
+        )
+        report = analyze_source(src, "corda_tpu/node/x.py")
+        assert "trace-stage-registry" in _rules(report)
+
+    def test_variable_metric_names_are_skipped(self):
+        # Dynamic names are the runtime registry's job (inc raises on an
+        # unregistered name) — the lexical rule only judges literals.
+        src = (
+            "from ..obs import telemetry as _tm\n"
+            "def f(name):\n"
+            "    _tm.inc(name)\n"
+        )
+        report = analyze_source(src, "corda_tpu/node/x.py")
+        assert "trace-stage-registry" not in _rules(report)
+
 
 # ---------------------------------------------------------------------------
 # Suppression + baseline machinery
