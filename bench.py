@@ -982,6 +982,50 @@ def bench_telemetry(n_tx=80):
     }
 
 
+def bench_doctor(report):
+    """The performance doctor's section (round 17): diagnose THIS report
+    and stamp the verdict into it — the roofline (committed/e2e rates vs
+    the measured kernel-stream ceiling, gap factored per layer) and the
+    evidence-ranked ``bottlenecks`` list with a suggested next experiment
+    per entry (obs/doctor). Then feed the trajectory store: normalize the
+    report into one schema-versioned record, compare it against the last
+    record of its kind (delta + regression gate under the default
+    tolerance policy), and append it to ``artifacts/TRAJECTORY.jsonl``
+    (``CORDA_TPU_TRAJECTORY`` overrides the path; append is best-effort —
+    a read-only checkout costs the append, never the verdict).
+
+    Runs LAST on both phase paths on purpose: the verdict must see every
+    section the run managed to produce, including the host-only path's
+    ``cpu_oracle_sigs_per_sec`` ceiling fallback."""
+    import os as _os
+
+    from corda_tpu.obs import doctor as _doctor
+    from corda_tpu.obs import telemetry as _tm
+
+    _tm.inc("doctor_runs_total")
+    verdict = _doctor.diagnose(_doctor.extract_signals(report))
+    record = _doctor.normalize_record(report, source="bench_run")
+    path = _os.environ.get("CORDA_TPU_TRAJECTORY") or _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "artifacts", "TRAJECTORY.jsonl")
+    out = {"verdict": verdict, "record": record,
+           "trajectory": {"path": path}}
+    try:
+        prior = _doctor.load_trajectory(path)
+        out["trajectory"]["delta"] = _doctor.trajectory_delta(prior, record)
+        gate = _doctor.gate(prior + [record])
+        out["trajectory"]["gate"] = gate
+        if not gate["ok"]:
+            _tm.inc("doctor_gate_regressions_total",
+                    len(gate["regressions"]))
+        _doctor.append_trajectory(path, record)
+        out["trajectory"]["appended"] = True
+    except (OSError, ValueError) as e:
+        out["trajectory"]["error"] = f"{type(e).__name__}: {e}"
+        out["trajectory"]["appended"] = False
+    return out
+
+
 def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
                        width=1, workers=3, chaos_rate=1200.0,
                        chaos_n_tx=600):
@@ -997,28 +1041,28 @@ def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
     percentiles, frames-per-tx (the send_many amortization, from worker
     transport deltas), the builder's ingest attribution block
     (tx_built_per_s / sigs_signed_per_s / serialize_ms / client cpu_s) and
-    the exactly-once audit. first_bottleneck names the busiest notarise
-    stage across the member stamps — at offered rates the client plane can
-    now pace, the residual ceiling is SERVER-side and this says where.
+    the exactly-once audit. first_bottleneck is the top of the perf
+    doctor's evidence-ranked attribution over the member stamps
+    (obs/doctor.stamp_attribution; the full ranked list rides under
+    "doctor") — at offered rates the client plane can now pace, the
+    residual ceiling is SERVER-side and this says where.
 
     A separate chaos leg re-runs one mid-ladder rate under the lossy plan
     (transport.send drop p=0.05, armed in members + workers): the durable
     outbox's fallback re-poll redelivers, so the audit must stay
     exactly-once — loss costs latency, never transactions."""
-    from collections import Counter
-
+    from corda_tpu.obs import doctor as _doctor
     from corda_tpu.tools.loadtest import run_ingest_sweep
 
     def _rows(sweep):
         return {f"{rate:g}_tx_s": r for rate, r in sweep.items()}
 
-    def _bottleneck(node_stamps):
-        stages = [s.get("busiest_stage") for s in (node_stamps or {}).values()
-                  if s and s.get("busiest_stage")]
-        return Counter(stages).most_common(1)[0][0] if stages else None
-
     sweep = run_ingest_sweep(rates=rates, n_tx=n_tx, width=width,
                              workers=workers)
+    # Sweeps stamp their own doctor attribution; a monkeypatched/legacy
+    # SweepResult without one gets attributed here from its stamps.
+    attribution = (getattr(sweep, "doctor", None)
+                   or _doctor.stamp_attribution(sweep.node_stamps))
     ok = [r for r in sweep.results.values() if "error" not in r]
     out = {"harness": "multiprocess-driver", "notary": "simple",
            "n_tx": n_tx, "width": width, "workers": workers,
@@ -1032,7 +1076,8 @@ def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
                (r["achieved_tx_s"] for r in ok), default=None),
            "exactly_once_all": (bool(ok) and len(ok) == len(sweep.results)
                                 and all(r["exactly_once"] for r in ok)),
-           "first_bottleneck": _bottleneck(sweep.node_stamps),
+           "first_bottleneck": attribution.get("first_bottleneck"),
+           "doctor": attribution,
            "node_stamps": sweep.node_stamps}
     try:
         chaos = run_ingest_sweep(rates=(chaos_rate,), n_tx=chaos_n_tx,
@@ -1750,6 +1795,15 @@ def _run_host_only_phases(report: dict,
     pks, msgs, sigs, _ = make_corpus()
     report["cpu_oracle_sigs_per_sec"] = round(
         bench_cpu_oracle(pks, msgs, sigs), 1)
+    # The doctor diagnoses the finished report — last, so its roofline
+    # sees the cpu_oracle ceiling this degraded path just measured.
+    set_phase("doctor")
+    try:
+        report["doctor"] = bench_doctor(report)
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["doctor"] = {"error": f"{type(e).__name__}: {e}"}
     set_phase("done")
 
 
@@ -1971,6 +2025,16 @@ def _run_phases(report: dict) -> None:
         raise
     except Exception as e:
         report["durability"] = {"error": f"{type(e).__name__}: {e}"}
+    # The doctor diagnoses the finished report — last, so its roofline
+    # sees every section (kernel ceiling, flagship, chaos) this run
+    # produced.
+    set_phase("doctor")
+    try:
+        report["doctor"] = bench_doctor(report)
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["doctor"] = {"error": f"{type(e).__name__}: {e}"}
     set_phase("done")
 
 

@@ -108,6 +108,10 @@ COUNTER_NAMES = (
     "sidecar_sigs_total",
     # The recorder's own audit trail.
     "flight_dumps_total",
+    # The performance doctor (obs/doctor.py, bench.bench_doctor):
+    # verdicts produced, and regressions the trajectory gate flagged.
+    "doctor_runs_total",
+    "doctor_gate_regressions_total",
 )
 
 HISTOGRAM_NAMES = (

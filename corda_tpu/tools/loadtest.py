@@ -33,6 +33,7 @@ from ..flows.api import FlowLogic, register_flow
 from ..flows.notary import NotaryClientFlow
 from ..node.config import BatchConfig, NodeConfig
 from ..node.node import Node
+from ..obs import doctor as _doctor
 from ..testing.dummies import DummyContract
 # Codec registration for the coordinator process: FirehoseResult rides the
 # flow_result RPC reply and must be decodable HERE, not just in the client
@@ -941,8 +942,10 @@ class MultiProcessResult:
 
 # A member that ran fewer rounds than this has a stage breakdown made of
 # noise (a 2-sample stage winning "busiest" steered a whole sweep's
-# first_bottleneck verdict) — below it, attribution abstains.
-BUSIEST_STAGE_MIN_ROUNDS = 20
+# first_bottleneck verdict) — below it, attribution abstains. The doctor
+# owns the constant (its round_breakdown merge honours the same floor);
+# this alias keeps the historical loadtest name importable.
+BUSIEST_STAGE_MIN_ROUNDS = _doctor.MIN_ATTRIBUTION_ROUNDS
 
 
 def _busiest_stage(stage: dict | None) -> str | None:
@@ -1041,7 +1044,11 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             # The round profiler's phase attribution (obs/telemetry.py):
             # the block that decomposes a busiest_stage of "rounds"/"pump"
             # into poll/verify_wait/seal/replicate/apply/reply shares.
-            "round_breakdown": metrics.get("round_breakdown")}
+            "round_breakdown": metrics.get("round_breakdown"),
+            # Admission-controller counters (rpc node_metrics "admission")
+            # so the doctor's shed-dominated rule has evidence in every
+            # stamp, not just slo_sweep's separate qos gather.
+            "admission": metrics.get("admission")}
 
 
 def run_loadtest_multiprocess(
@@ -1398,6 +1405,19 @@ class SweepResult:
     # Flight-recorder artifact paths the sweep produced (slo_sweep with
     # flight_dir set: the latched slo_breach dump); None when unarmed.
     flight: list | None = None
+    # The performance doctor's evidence-ranked attribution over the
+    # member stamps (obs/doctor.stamp_attribution): ranked bottlenecks
+    # with per-entry evidence + next experiment. This — not the legacy
+    # Counter-majority over busiest_stage — is where first_bottleneck
+    # comes from; None when the sweep gathered no stamps.
+    doctor: dict | None = None
+
+    @property
+    def first_bottleneck(self):
+        """Top of the doctor's ranked bottleneck list; honest None when
+        no member produced enough evidence (the <MIN_ATTRIBUTION_ROUNDS
+        abstention contract survives end-to-end)."""
+        return (self.doctor or {}).get("first_bottleneck")
 
     def __getitem__(self, rate):
         return self.results[rate]
@@ -1643,7 +1663,8 @@ def run_latency_sweep(
             if isinstance(trace, str):
                 _write_trace(trace, snapshots)
     return SweepResult(results=results, node_stamps=stamps,
-                       trace_snapshots=snapshots, sidecar=side_stats)
+                       trace_snapshots=snapshots, sidecar=side_stats,
+                       doctor=_doctor.stamp_attribution(stamps))
 
 
 def run_slo_sweep(
@@ -1863,7 +1884,8 @@ def run_slo_sweep(
                        sidecar=side_stats, qos=qstats or None,
                        telemetry=collect_cluster(tsnaps) if tsnaps else None,
                        flight=(sorted(recorder.dumped.values())
-                               if recorder is not None else None))
+                               if recorder is not None else None),
+                       doctor=_doctor.stamp_attribution(stamps))
 
 
 _LOSSY_PLAN_TOML = """\
@@ -2049,7 +2071,8 @@ def run_ingest_sweep(
             # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep
-    return SweepResult(results=results, node_stamps=stamps)
+    return SweepResult(results=results, node_stamps=stamps,
+                       doctor=_doctor.stamp_attribution(stamps))
 
 
 def main(argv=None) -> int:
@@ -2161,6 +2184,8 @@ def main(argv=None) -> int:
         print(json.dumps({
             "rates": {f"{rate:g}": row for rate, row in sweep.items()},
             "node_stamps": sweep.node_stamps,
+            "first_bottleneck": sweep.first_bottleneck,
+            "doctor": sweep.doctor,
         }))
         return 0
     if args.offered_load:
@@ -2179,6 +2204,8 @@ def main(argv=None) -> int:
                       for rate, by_lane in sweep.items()},
             "node_stamps": sweep.node_stamps,
             "qos": sweep.qos,
+            "first_bottleneck": sweep.first_bottleneck,
+            "doctor": sweep.doctor,
         }))
         return 0
     if args.chaos is not None or args.kill_leader:

@@ -31,6 +31,9 @@ def _stub_phases(monkeypatch):
                  "bench_telemetry",  # ditto: an in-process loadtest round
                  "bench_reshard",  # ditto: live split + merge in-process nets
                  "bench_durability",  # ditto: a bitrot chaos soak + fsck
+                 "bench_doctor",  # unstubbed, this one APPENDS to the
+                 # checked-in artifacts/TRAJECTORY.jsonl from every report
+                 # test — test pollution in the working tree
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -93,6 +96,9 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # The durability section (round 14) rides the device phase path — the
     # host-only path asserts it separately; schema parity both ways.
     assert report["durability"] == {"stub": "bench_durability"}
+    # The perf-doctor section (round 17) rides the device phase path —
+    # the host-only path asserts it separately; schema parity both ways.
+    assert report["doctor"] == {"stub": "bench_doctor"}
     assert "phase" not in report
 
 
@@ -162,6 +168,9 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_validating_flagship"}
     assert report["durability"] == {"stub": "bench_durability"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
+    # The doctor runs LAST on the host-only path too — after the
+    # cpu_oracle ceiling it diagnoses against.
+    assert report["doctor"] == {"stub": "bench_doctor"}
 
 
 def test_watchdog_during_headline_phase_reports_honest_zero(monkeypatch,
@@ -590,8 +599,16 @@ def test_ingest_sweep_report_contract(monkeypatch):
     assert out["peak_offered_tx_s"] == 10000.0
     assert out["peak_achieved_tx_s"] == 8000.0
     assert out["exactly_once_all"] is True
-    # Server-side attribution: the majority busiest stage across members.
+    # Server-side attribution: the doctor's evidence-ranked verdict over
+    # the member stamps (majority busiest stage wins here), with the full
+    # ranked list + evidence riding under "doctor".
     assert out["first_bottleneck"] == "fsync"
+    assert out["doctor"]["first_bottleneck"] == "fsync"
+    top = out["doctor"]["bottlenecks"][0]
+    assert top["cause"] == "fsync"
+    assert top["evidence"]["busiest_stage_by_member_count"] == {
+        "fsync": 2, "verify": 1}
+    assert top["next_experiment"]  # every entry names its next move
     # Chaos leg verdict: exactly-once held under the lossy plan.
     assert out["chaos"]["plan"] == "lossy"
     assert out["chaos"]["exactly_once"] is True
@@ -836,3 +853,77 @@ def test_durability_report_isolates_subrun_errors(monkeypatch):
     assert "exactly_once" not in out  # never fabricated from a dead run
     assert out["detect_repair_micro"]["clean_after_repair"] is True
     assert out["repair_s"] > 0.0
+
+
+def _doctor_report():
+    # The minimal bench-report shape the doctor diagnoses: a kernel
+    # ceiling, a flagship with low occupancy, and an ingest peak.
+    return {
+        "metric": "verified_sigs_per_sec", "value": 1200.0,
+        "e2e_stream_sigs_per_sec": 100_000.0,
+        "kernel_sigs_per_sec": {"4096": 90_000.0},
+        "baseline_configs": {
+            "raft_validating_3node": {
+                "tx_per_sec": 44.0, "p99_ms": 3800.0,
+                "loadtest_sigs_per_sec": 2900.0,
+                "node_stamps": {
+                    "Raft0": {"device_batches": 5, "host_batches": 6}}},
+            "ingest_sweep": {"peak_achieved_tx_s": 190.0}},
+    }
+
+
+def test_doctor_section_contract(monkeypatch, tmp_path):
+    """The doctor section's one-line-JSON contract (round 17): the
+    verdict (roofline + ranked bottlenecks), the normalized trajectory
+    record, and the trajectory block (path, delta vs the last record of
+    this kind, gate) — serializable, and actually appended to the store
+    the env var points at (never the checked-in one from a test)."""
+    store = tmp_path / "TRAJECTORY.jsonl"
+    monkeypatch.setenv("CORDA_TPU_TRAJECTORY", str(store))
+    out = bench.bench_doctor(_doctor_report())
+
+    json.dumps(out)  # the one-line contract: fully serializable
+    v = out["verdict"]
+    assert v["first_bottleneck"] == "device_occupancy"
+    assert v["roofline"]["ceiling_sigs_per_sec"] == 100_000.0
+    assert v["roofline"]["gap_factor"] == round(100_000.0 / 2900.0, 2)
+    assert v["bottlenecks"][0]["next_experiment"]
+    rec = out["record"]
+    assert rec["kind"] == "bench_report"
+    assert rec["metrics"]["flagship_tx_per_sec"] == 44.0
+    assert rec["metrics"]["ingest_peak_achieved_tx_s"] == 190.0
+    # First run: appended, no predecessor of this kind to diff against.
+    assert out["trajectory"]["appended"] is True
+    assert out["trajectory"]["delta"] is None
+    assert out["trajectory"]["gate"]["ok"] is True
+    assert store.exists()
+
+    # Second run, 25% p99 regression: the delta and the gate both say so
+    # in the section — and the run still appends (the gate INFORMS the
+    # bench report; perfdoctor --gate is where it blocks).
+    worse = _doctor_report()
+    worse["baseline_configs"]["raft_validating_3node"]["p99_ms"] = 4750.0
+    out2 = bench.bench_doctor(worse)
+    json.dumps(out2)
+    assert out2["trajectory"]["delta"]["metrics"][
+        "flagship_p99_ms"]["change_pct"] == 25.0
+    gate = out2["trajectory"]["gate"]
+    assert gate["ok"] is False
+    assert gate["regressions"][0]["metric"] == "flagship_p99_ms"
+    assert out2["trajectory"]["appended"] is True
+    assert len(store.read_text().splitlines()) == 2
+
+
+def test_doctor_section_isolates_store_errors(monkeypatch, tmp_path):
+    """An unwritable/corrupt trajectory store costs the trajectory block
+    only — the verdict and record still land in the report (the doctor
+    section never takes down the one-line contract)."""
+    blocker = tmp_path / "occupied"
+    blocker.write_text("not json {")
+    monkeypatch.setenv("CORDA_TPU_TRAJECTORY", str(blocker))
+    out = bench.bench_doctor(_doctor_report())
+    json.dumps(out)
+    assert out["verdict"]["first_bottleneck"] == "device_occupancy"
+    assert out["record"]["kind"] == "bench_report"
+    assert out["trajectory"]["appended"] is False
+    assert "ValueError" in out["trajectory"]["error"]

@@ -284,6 +284,19 @@ def test_clean_venv_install_smoke(tmp_path):
     import subprocess
     import sys
 
+    # The probe asserts the OpenSSL fast path is ACTIVE, which needs the
+    # wheel; and pip refuses the install below requires-python (>=3.11).
+    # On a container missing either, this is an environment gap, not a
+    # packaging regression — skip with the reason instead of failing.
+    pytest.importorskip(
+        "cryptography",
+        reason="the 'cryptography' wheel is not installed — the install "
+               "probe asserts the OpenSSL fast path is active")
+    if sys.version_info < (3, 11):
+        pytest.skip("interpreter is %d.%d but pyproject requires-python is "
+                    ">=3.11 — pip rejects the install before packaging is "
+                    "exercised" % sys.version_info[:2])
+
     import os
     import sysconfig
 

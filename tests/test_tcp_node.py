@@ -222,6 +222,10 @@ class TestTlsTransport:
     def test_notarisation_over_mutual_tls(self, tmp_path):
         """TLS-enabled nodes (certs chained to the shared dev CA) complete a
         notarisation; a plaintext client cannot talk to a TLS node."""
+        pytest.importorskip(
+            "cryptography",
+            reason="the 'cryptography' wheel is not installed — TLS "
+                   "material generation (crypto/x509.py) requires it")
         notary = make_node(tmp_path, "Notary", notary="simple", tls=True)
         alice = make_node(tmp_path, "Alice", tls=True)
         nodes = [notary, alice]

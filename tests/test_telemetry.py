@@ -237,6 +237,72 @@ def test_collect_cluster_reports_missing_nodes(fresh):
     assert out["merged"]["counters"]["rounds_total"] == 4
 
 
+def test_collect_cluster_zero_sample_node_merges_exactly(fresh):
+    """A node that served a snapshot but never observed anything (all
+    counters 0, no histogram samples) is PRESENT — not missing — and its
+    zeros must not perturb the fold (the doctor reads merged counters;
+    an idle member silently dropped would skew per-node ratios)."""
+    busy, idle = tm.TelemetryRegistry(), tm.TelemetryRegistry()
+    busy.counter("verify_sigs_total").add(7)
+    busy.histogram("verify_batch_sigs").observe(5)
+    out = collect_cluster({"busy": busy.snapshot(),
+                           "idle": idle.snapshot()})
+    assert out["missing"] == []
+    assert set(out["nodes"]) == {"busy", "idle"}
+    assert out["merged"]["counters"]["verify_sigs_total"] == 7
+    h = out["merged"]["histograms"]["verify_batch_sigs"]
+    assert h["count"] == 1 and h["buckets"] == {"3": 1}
+    # And the merged view still renders/parses as valid exposition.
+    parsed = parse_prometheus(render_prometheus(out["merged"]))
+    assert parsed["counters"]["verify_sigs_total"] == 7
+
+
+def test_merge_tolerates_stale_snapshot_schema(fresh):
+    """A stale snapshot — captured by an older build that knew fewer
+    metrics (keys absent entirely) and whose histogram block predates
+    some fields — merges without KeyError: absent counters contribute 0,
+    absent histogram fields default, and the newer node's series all
+    survive. This is the rolling-upgrade shape collect_cluster meets."""
+    new = tm.TelemetryRegistry()
+    new.counter("doctor_runs_total").add(3)
+    new.counter("rounds_total").add(10)
+    new.histogram("round_wall_seconds").observe(0.25)
+    stale = {"counters": {"rounds_total": 4.0},
+             # Old shape: no scale, no sum, sparse buckets only.
+             "histograms": {"round_wall_seconds": {"count": 2,
+                                                   "buckets": {"17": 2}}}}
+    merged = merge_snapshots([stale, new.snapshot()])
+    assert merged["counters"]["rounds_total"] == 14
+    assert merged["counters"]["doctor_runs_total"] == 3
+    h = merged["histograms"]["round_wall_seconds"]
+    assert h["count"] == 3
+    # 0.25 s at the _seconds scale (1e6) lands in bucket 2^18; the stale
+    # block's bucket 17 survives beside it with its own count.
+    assert h["buckets"] == {"17": 2, "18": 1}
+    # The merged histogram still renders as monotonic exposition.
+    parse_prometheus(render_prometheus(merged))
+
+
+def test_merge_disjoint_sparse_buckets_is_exact(fresh):
+    """Two nodes whose sparse histograms share NO bucket index merge by
+    union — every index survives with its own count, ordered, and the
+    cumulative exposition stays monotonic (the power-of-two indices
+    align across processes by construction, so this is exact)."""
+    a, b = tm.TelemetryRegistry(), tm.TelemetryRegistry()
+    a.histogram("verify_batch_sigs").observe(2)     # bucket idx 2
+    a.histogram("verify_batch_sigs").observe(2)
+    b.histogram("verify_batch_sigs").observe(1000)  # bucket idx 10
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    h = merged["histograms"]["verify_batch_sigs"]
+    assert h["buckets"] == {"2": 2, "10": 1}
+    assert list(h["buckets"]) == ["2", "10"]  # index-sorted
+    assert h["count"] == 3 and h["sum"] == pytest.approx(1004.0)
+    parsed = parse_prometheus(render_prometheus(merged))
+    cums = [c for _, c in
+            parsed["histograms"]["verify_batch_sigs"]["buckets"]]
+    assert cums == [2, 3, 3]  # cumulative across the disjoint union
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder: ring, deltas, and the exactly-one-artifact latch
 # ---------------------------------------------------------------------------
