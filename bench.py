@@ -1028,7 +1028,8 @@ def bench_doctor(report):
 
 def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
                        width=1, workers=3, chaos_rate=1200.0,
-                       chaos_n_tx=600):
+                       chaos_n_tx=600, pipeline_rate=2400.0,
+                       pipeline_n_tx=600):
     """The vectorized ingest plane's capability section (round 15, ROADMAP
     item 2): ONE builder process columnar-builds + batch-signs + serializes
     the whole corpus (loadgen.IngestBuildFlow -> a CTI1 multi-tx frame),
@@ -1050,7 +1051,14 @@ def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
     A separate chaos leg re-runs one mid-ladder rate under the lossy plan
     (transport.send drop p=0.05, armed in members + workers): the durable
     outbox's fallback re-poll redelivers, so the audit must stay
-    exactly-once — loss costs latency, never transactions."""
+    exactly-once — loss costs latency, never transactions.
+
+    A pipeline-delta leg (round 18) runs the SAME raft workload twice —
+    serial reference ([raft] pipeline=false) vs pipelined commit plane —
+    and stamps committed-tx/s for both plus their ratio as
+    pipeline_speedup, which perfdoctor --gate bands (higher-is-better):
+    a regression that silently flattens the overlap win fails CI even
+    when the simple-notary ladder above still looks healthy."""
     from corda_tpu.obs import doctor as _doctor
     from corda_tpu.tools.loadtest import run_ingest_sweep
 
@@ -1090,6 +1098,26 @@ def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
                         "row": _rows(chaos)}
     except Exception as e:
         out["chaos"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        legs = {}
+        for label, piped in (("serial", False), ("pipelined", True)):
+            leg = run_ingest_sweep(
+                rates=(pipeline_rate,), n_tx=pipeline_n_tx, width=width,
+                workers=workers, notary="raft", pipeline=piped)
+            legs[label] = leg.results.get(pipeline_rate) or {}
+        s = legs["serial"].get("achieved_tx_s")
+        p = legs["pipelined"].get("achieved_tx_s")
+        out["pipeline_delta"] = {
+            "notary": "raft", "rate_tx_s": pipeline_rate,
+            "n_tx": pipeline_n_tx,
+            "committed_tx_s_serial": s,
+            "committed_tx_s_pipelined": p,
+            "pipeline_speedup": (round(p / s, 3) if s and p else None),
+            "exactly_once_both": bool(
+                legs["serial"].get("exactly_once")
+                and legs["pipelined"].get("exactly_once"))}
+    except Exception as e:
+        out["pipeline_delta"] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
 

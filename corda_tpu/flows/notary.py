@@ -561,7 +561,10 @@ class NotaryServiceFlow(FlowLogic):
             UniquenessException,
             UniquenessUnavailableException,
         )
-        from ..node.services.raft import WrongShardEpochException
+        from ..node.services.raft import (
+            CommitQueueFullException,
+            WrongShardEpochException,
+        )
         from ..serialization.codec import serialize
 
         provider = self.service.uniqueness_provider
@@ -576,6 +579,14 @@ class NotaryServiceFlow(FlowLogic):
             conflict_data = serialize(e.error)
             signed = SignedData(conflict_data, self.service.sign(conflict_data.bytes))
             raise NotaryException(NotaryConflict(wtx.id, signed)) from e
+        except CommitQueueFullException as e:
+            # Must precede the generic unavailability mapping (subclass):
+            # a full commit queue is the pipelined apply executor's
+            # admission shed — surface it as the SAME retryable overload
+            # error the QoS admission plane uses, so notarise_with_retry's
+            # shed-backoff handling covers both layers.
+            raise NotaryException(OverloadedError(
+                "commit", CommitQueueFullException.RETRY_AFTER_MS)) from e
         except WrongShardEpochException as e:
             # Must precede the generic unavailability mapping (it is a
             # subclass): a fence bounce is retryable but the client has to

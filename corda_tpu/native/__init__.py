@@ -106,6 +106,12 @@ def _libcrypto_path():
     return None
 
 
+def load_ccommit():
+    """The batched CRC32C integrity-frame core (`_ccommit.c`, wired in by
+    node/services/integrity.py for the columnar commit path)."""
+    return _load_native("_ccommit")
+
+
 def load_cverify():
     """The batched libcrypto Ed25519 verify core (`_cverify.c`, wired in
     by corda_tpu/crypto/provider.py). None when libcrypto is absent."""

@@ -80,6 +80,26 @@ class RaftConfig:
     pipeline_window: int = 1024
     # Entries per AppendEntries frame when streaming a tail.
     append_chunk: int = 256
+    # Pipelined commit plane (round 18): overlap consecutive rounds. The
+    # leader seals round N+1 while round N is still replicating (mid-round
+    # seals ride the pipeline_window), and committed-entry apply + client
+    # reply construction detach onto a dedicated executor thread fed by a
+    # bounded queue. False restores the serial seal→replicate→apply→reply
+    # loop, bit-identical to the pre-pipeline ledger.
+    pipeline: bool = True
+    # Bound of the commit queue feeding the apply executor, in log
+    # entries. When the queue is full the leader sheds NEW submissions
+    # with a retryable OverloadedError("commit") instead of growing an
+    # unbounded backlog (committed-but-unapplied entries are durable in
+    # the log and drain as the executor catches up). 0 disables the
+    # executor even when pipeline=true (inline apply, pipelined seals).
+    apply_queue_depth: int = 4096
+    # Columnar fast path: apply a run of PutAll commands from one batch
+    # with set-wide conflict/reservation SELECTs and executemany inserts
+    # (plus the native _ccommit CRC32C batch helper when built) instead
+    # of per-ref statements. Byte-identical rows; False falls back to the
+    # per-command apply.
+    commit_many: bool = True
 
 
 @dataclass(frozen=True)
@@ -259,6 +279,9 @@ class NodeConfig:
                 group_commit=bool(raft.get("group_commit", True)),
                 pipeline_window=int(raft.get("pipeline_window", 1024)),
                 append_chunk=int(raft.get("append_chunk", 256)),
+                pipeline=bool(raft.get("pipeline", True)),
+                apply_queue_depth=int(raft.get("apply_queue_depth", 4096)),
+                commit_many=bool(raft.get("commit_many", True)),
             ),
             qos=QosConfig(
                 enabled=bool(qos.get("enabled", False)),

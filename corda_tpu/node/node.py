@@ -590,6 +590,11 @@ class Node:
         rm = self.raft_member
         raft_pre = ((rm.phase_s["seal"], rm.phase_s["replicate"],
                      rm.phase_s["apply"]) if rm is not None else None)
+        # Pipelined commit plane: executor wall time overlapped under this
+        # round (accumulated by the executor thread, read as a delta here).
+        # Tracked BESIDE the six phases — see format_breakdown's overlap
+        # block — so phase coverage stays a partition of loop wall time.
+        overlap_pre = (rm.overlap_s["apply"] if rm is not None else 0.0)
         t = time.perf_counter
         t_pre = t()
         try:
@@ -707,6 +712,13 @@ class Node:
         rp["replicate"] += repl_d
         rp["apply"] += apply_s
         rp["reply"] += reply
+        if rm is not None:
+            overlap_d = rm.overlap_s["apply"] - overlap_pre
+            if overlap_d > 0.0:
+                rp["overlap_apply"] = (
+                    rp.get("overlap_apply", 0.0) + overlap_d)
+                if _tm.ACTIVE is not None:
+                    _tm.inc("round_overlap_apply_seconds_total", overlap_d)
         if _tm.ACTIVE is not None:
             _tm.observe_round(t_end - t_pre, {
                 "poll": poll, "verify_wait": verify_wait, "seal": seal_d,

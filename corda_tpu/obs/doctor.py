@@ -156,8 +156,35 @@ _GENERIC_SUGGESTION = (
     "profile stage {cause!r} with --trace (obs/collect stage_breakdown) — "
     "no specific rule for it yet")
 
+# Pipelined-commit-plane overlay (round 18): once the round loop overlaps
+# (raft pipeline=true — mid-round seals, detached apply executor), the
+# serial-loop suggestions above are ALREADY DONE. A run whose members
+# stamp pipeline=true gets the NEXT experiment for these causes instead
+# of re-suggesting round-loop amortization it has already applied.
+PIPELINED_RULES: dict = {
+    "rounds": (
+        "the round loop is already pipelined — sweep the executor levers "
+        "instead: [raft] apply_queue_depth (commit-queue bound) and the "
+        "native commit_many columnar batch (CORDA_TPU_NO_NATIVE unset), "
+        "then re-attribute; residual 'rounds' wall is scheduler/transport, "
+        "not seal/apply serialization"),
+    "seal": (
+        "seals already overlap replication (mid-round seals) — tune "
+        "append_chunk (mid-round seal trigger) and group-commit density "
+        "rather than the round cadence"),
+    "apply": (
+        "apply is already detached onto the executor — sweep [raft] "
+        "apply_queue_depth and profile the columnar commit_many path "
+        "(set-wide conflict SELECTs, executemany inserts, native CRC "
+        "batching)"),
+}
 
-def _suggest(cause: str) -> str:
+
+def _suggest(cause: str, pipelined: bool = False) -> str:
+    if pipelined:
+        hit = PIPELINED_RULES.get(cause)
+        if hit:
+            return hit
     return RULES.get(cause) or _GENERIC_SUGGESTION.format(cause=cause)
 
 
@@ -218,8 +245,19 @@ def _merge_breakdowns(breakdowns: list) -> dict | None:
     }
 
 
+def _pipeline_enabled(stamps) -> bool:
+    """True when some member's raft stamp says the pipelined commit plane
+    is on — flips the stage rules to their PIPELINED_RULES overlay."""
+    for s in stamps:
+        raft = s.get("raft") if isinstance(s, dict) else None
+        if isinstance(raft, dict) and raft.get("pipeline"):
+            return True
+    return False
+
+
 def _candidates(signals: dict) -> list[dict]:
     out: list[dict] = []
+    pipelined = bool(signals.get("pipeline_enabled"))
 
     # Rule: low device occupancy -> coalesce/bucket ladder. Evidence is
     # the per-member routing split (the r05 regression shape: the device
@@ -255,7 +293,7 @@ def _candidates(signals: dict) -> list[dict]:
                     "score": round(0.5 + 0.5 * frac, 4),
                     "evidence": {"busiest_stage_by_member_count": counts,
                                  "members_reporting": len(stages)},
-                    "next_experiment": _suggest(top)})
+                    "next_experiment": _suggest(top, pipelined)})
 
     # Rule: dominant round phase from the merged telemetry profiler
     # breakdown — the block that decomposes a "rounds" wall into
@@ -274,7 +312,7 @@ def _candidates(signals: dict) -> list[dict]:
                                  {p: round(v, 4)
                                   for p, v in sorted(phases.items())},
                                  "rounds": breakdown.get("rounds")},
-                    "next_experiment": _suggest(top)})
+                    "next_experiment": _suggest(top, pipelined)})
 
     # Rule: high mesh pad fraction -> bucket growth.
     pad = _finite(signals.get("pad_fraction"))
@@ -349,6 +387,7 @@ def stamp_attribution(node_stamps: dict | None) -> dict:
         "busiest_stages": [s.get("busiest_stage") for s in stamps],
         "round_breakdown": _merge_breakdowns(breakdowns),
         "admission": {"admitted": admitted, "shed": shed},
+        "pipeline_enabled": _pipeline_enabled(stamps),
     }
     bottlenecks = _candidates(signals)
     return {
@@ -490,6 +529,7 @@ def extract_signals(artifact: dict) -> dict:
         merged = _merge_breakdowns(breakdowns)
         if merged:
             signals["round_breakdown"] = merged
+        signals["pipeline_enabled"] = _pipeline_enabled(stamps.values())
     # Fall back to the roundtrip probe's routing split when the flagship
     # carried no stamps (the r05_a shape): it exercised the same verify
     # plane, so its device/host split is honest occupancy evidence.
@@ -624,6 +664,10 @@ def _hoist_metrics(artifact: dict, kind: str) -> dict:
         if isinstance(ingest, dict) and "error" not in ingest:
             put("ingest_peak_achieved_tx_s",
                 ingest.get("peak_achieved_tx_s"))
+            delta = ingest.get("pipeline_delta")
+            if isinstance(delta, dict):
+                put("ingest_pipeline_speedup",
+                    delta.get("pipeline_speedup"))
         slo = configs.get("slo_sweep")
         if isinstance(slo, dict):
             verdict = slo.get("verdict") or {}
@@ -656,6 +700,11 @@ def _hoist_metrics(artifact: dict, kind: str) -> dict:
             ingest = peak.get("ingest") or {}
             put("tx_built_per_s", ingest.get("tx_built_per_s"))
             put("sigs_signed_per_s", ingest.get("sigs_signed_per_s"))
+        delta = artifact.get("pipeline_delta")
+        if isinstance(delta, dict):
+            put("pipeline_speedup", delta.get("pipeline_speedup"))
+            put("committed_tx_s_pipelined",
+                delta.get("committed_tx_s_pipelined"))
     elif kind == "multichip_capture":
         section = artifact.get("multichip_scaling") or {}
         widths = [w for w in (section.get("devices") or {}).values()
@@ -748,6 +797,13 @@ DEFAULT_POLICY: dict = {
     "sigs_signed_per_s": {"direction": "higher", "pct": 20.0},
     "p99_ms": {"direction": "lower", "pct": 20.0},
     "ingest_peak_achieved_tx_s": {"direction": "higher", "pct": 20.0},
+    # Pipelined-vs-serial commit-plane delta (round 18): the speedup
+    # ratio AND the pipelined path's absolute committed-tx/s are both
+    # banded, so a change that flattens the overlap win fails the gate
+    # even while serial throughput holds.
+    "pipeline_speedup": {"direction": "higher", "pct": 20.0},
+    "committed_tx_s_pipelined": {"direction": "higher", "pct": 20.0},
+    "ingest_pipeline_speedup": {"direction": "higher", "pct": 20.0},
     "max_width_sigs_per_sec": {"direction": "higher", "pct": 20.0},
     "multichip_scaling_1_to_max": {"direction": "higher", "pct": 20.0},
     "exactly_once_all": {"direction": "equal"},

@@ -99,6 +99,13 @@ COUNTER_NAMES = (
     # Raft leader seal path (services/raft.py).
     "raft_seals_total",
     "raft_seal_entries_total",
+    # Pipelined commit plane (services/raft.py apply executor): wall time
+    # the executor overlapped under the consensus thread (kept OUT of the
+    # round_phase_* family so phase coverage never double-counts it),
+    # executor batches completed, and submissions shed off a full queue.
+    "round_overlap_apply_seconds_total",
+    "raft_apply_batches_total",
+    "raft_apply_shed_total",
     # Admission controller (qos/admission.py).
     "admission_admitted_total",
     "admission_shed_total",
@@ -124,6 +131,7 @@ HISTOGRAM_NAMES = (
     "round_phase_reply_seconds",
     "verify_batch_sigs",
     "raft_seal_entries",
+    "raft_apply_batch_commands",
     "sidecar_batch_sigs",
 )
 
@@ -480,7 +488,7 @@ def format_breakdown(round_phase_s: dict | None) -> dict | None:
         covered += v
         phases[p] = {"total_s": round(v, 6),
                      "share": round(v / wall, 4) if wall else None}
-    return {
+    out = {
         "rounds": rounds,
         "wall_s": round(wall, 6),
         "phases": phases,
@@ -488,3 +496,14 @@ def format_breakdown(round_phase_s: dict | None) -> dict | None:
         "busiest_phase": max(ROUND_PHASES,
                              key=lambda p: rp.get(p, 0.0) or 0.0),
     }
+    # Pipelined commit plane: executor wall time that ran UNDER the six
+    # in-loop phases. Reported beside them, never inside — coverage stays
+    # a partition of the consensus thread's wall time (no double counts),
+    # and vs_wall > 0 is the self-describing proof rounds overlapped.
+    overlap = rp.get("overlap_apply", 0.0) or 0.0
+    if overlap:
+        out["overlap"] = {"apply": {
+            "total_s": round(overlap, 6),
+            "vs_wall": round(overlap / wall, 4) if wall else None,
+        }}
+    return out

@@ -34,6 +34,7 @@ from ..flows.notary import NotaryClientFlow
 from ..node.config import BatchConfig, NodeConfig
 from ..node.node import Node
 from ..obs import doctor as _doctor
+from ..obs import telemetry as _tm
 from ..testing.dummies import DummyContract
 # Codec registration for the coordinator process: FirehoseResult rides the
 # flow_result RPC reply and must be decodable HERE, not just in the client
@@ -70,7 +71,11 @@ def _rebuild(config: NodeConfig) -> Node:
         name=config.name, base_dir=config.base_dir, notary=config.notary,
         raft_cluster=config.raft_cluster, network_map=config.network_map,
         batch=config.batch, verifier=config.verifier,
-        notary_shards=config.notary_shards)).start()
+        notary_shards=config.notary_shards,
+        # A rebuilt member must rejoin with the SAME commit-plane policy
+        # (pipeline/apply_queue_depth/...) — silently reverting to defaults
+        # would let a chaos run flip a serial A/B leg pipelined mid-kill.
+        raft=config.raft)).start()
 
 
 def _collect_trace_snapshots(rpcs) -> list[dict]:
@@ -957,24 +962,55 @@ def _busiest_stage(stage: dict | None) -> str | None:
       same dict as the float seconds (the unguarded ``max(stage,
       key=stage.get)`` happily crowned it after ~200 rounds);
     * breaks ties deterministically (alphabetically first of the maxima)
-      so two equal stages can't flap the sweep verdict between runs."""
+      so two equal stages can't flap the sweep verdict between runs.
+    * abstains when every timed value is zero — a freshly-deltaed window
+      that did no measured work has no busiest stage, and crowning the
+      alphabetical first would be a fabricated verdict."""
     stage = stage or {}
     if stage.get("rounds", 0) < BUSIEST_STAGE_MIN_ROUNDS:
         return None
     timed = {k: v for k, v in stage.items() if k != "rounds"}
-    if not timed:
+    if not timed or all((v or 0) <= 0 for v in timed.values()):
         return None
     return max(sorted(timed), key=timed.get)
 
 
-def _member_stamp(metrics: dict, device: str) -> dict:
+def _delta_counters(current: dict | None, baseline: dict | None) -> dict:
+    """Per-key numeric delta of a cumulative counter dict against a
+    baseline snapshot (missing baseline keys count 0; negatives clamp —
+    a member restart resets its counters)."""
+    current = current or {}
+    baseline = baseline or {}
+    out = {}
+    for k, v in current.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[k] = max(type(v)(0), v - (baseline.get(k) or 0))
+    return out
+
+
+def _member_stamp(metrics: dict, device: str,
+                  baseline: dict | None = None) -> dict:
     """One notary member's self-describing stamp from its node_metrics
     snapshot: verifier/backend/device identity, device-vs-host routing,
     and the async-pipeline numbers (depth + overlap ratio: the fraction
     of verify wall time served on the feeder thread instead of inside
-    the round — 0.0/None when the pipeline is off or never engaged)."""
+    the round — 0.0/None when the pipeline is off or never engaged).
+
+    ``baseline`` (an earlier node_metrics snapshot, e.g. taken after
+    warmup) switches the round attribution fields — busiest_stage and
+    round_breakdown — to DELTAS over the measured window. Cumulative
+    stamps were the stale-carryover trap: a short measured leg inherited
+    warmup + earlier legs' round counters, so attribution named whatever
+    the PREVIOUS workload was bound by."""
     av = metrics.get("async_verify") or {}
     stage = metrics.get("round_stage_s") or {}
+    if baseline is not None:
+        stage = _delta_counters(stage, baseline.get("round_stage_s"))
+        breakdown = _tm.format_breakdown(_delta_counters(
+            metrics.get("round_phase_s"), baseline.get("round_phase_s")))
+    else:
+        breakdown = metrics.get("round_breakdown")
     wall = av.get("verify_wall_s", 0.0) or 0.0
     in_loop = stage.get("verify", 0.0) or 0.0
     overlap = (round(wall / (wall + in_loop), 3)
@@ -1043,8 +1079,9 @@ def _member_stamp(metrics: dict, device: str) -> dict:
             "busiest_stage": _busiest_stage(stage),
             # The round profiler's phase attribution (obs/telemetry.py):
             # the block that decomposes a busiest_stage of "rounds"/"pump"
-            # into poll/verify_wait/seal/replicate/apply/reply shares.
-            "round_breakdown": metrics.get("round_breakdown"),
+            # into poll/verify_wait/seal/replicate/apply/reply shares —
+            # delta-windowed when the caller supplied a baseline.
+            "round_breakdown": breakdown,
             # Admission-controller counters (rpc node_metrics "admission")
             # so the doctor's shed-dominated rule has evidence in every
             # stamp, not just slo_sweep's separate qos gather.
@@ -1919,6 +1956,9 @@ def run_ingest_sweep(
     max_seconds: float = 600.0,
     async_verify: bool = True,
     async_depth: int = 2,
+    pipeline: bool = True,  # commit-plane round pipelining ([raft]
+    # pipeline): False runs the serial reference path for before/after
+    # committed-tx/s deltas (bench.bench_ingest_sweep stamps both)
 ) -> SweepResult:
     """The multiprocess ingest firehose: ONE builder process constructs,
     batch-signs and serializes the whole corpus (loadgen.IngestBuildFlow →
@@ -1947,7 +1987,8 @@ def run_ingest_sweep(
                 f"max_wait_ms = {max_wait_ms}\n"
                 f"coalesce_ms = {coalesce_ms}\n"
                 f"async_verify = {str(async_verify).lower()}\n"
-                f"async_depth = {async_depth}\n")
+                f"async_depth = {async_depth}\n"
+                f"[raft]\npipeline = {str(pipeline).lower()}\n")
 
     chaos_env = None
     if chaos:
@@ -2007,6 +2048,18 @@ def run_ingest_sweep(
         _await([(r, r.call("start_flow_dynamic", "loadgen.FirehoseFlow",
                            (3, 1, 3, 0.0))) for r in worker_rpcs],
                "ingest-sweep warmup")
+        # Post-warmup baseline snapshots: the end-of-sweep member stamps
+        # delta against these, so busiest_stage / round_breakdown describe
+        # the MEASURED legs — cumulative stamps carried warmup and earlier
+        # rate legs into the verdict (the stale-"rounds" trap: a short
+        # pipelined run inherited the previous workload's attribution).
+        baselines: dict = {}
+        for m, r in zip(members, member_rpcs):
+            try:
+                baselines[m.name] = r.call("node_metrics")
+            # lint: allow(no-silent-except) sweep tooling: losing a baseline degrades one stamp to cumulative, not the sweep
+            except Exception:
+                pass
         for rate in rates:
             try:
                 corpus_path = str(base / f"corpus-{rate:g}.bin")
@@ -2067,7 +2120,8 @@ def run_ingest_sweep(
         for m, r in zip(members, member_rpcs):
             try:
                 stamps[m.name] = _member_stamp(
-                    r.call("node_metrics"), m.device)
+                    r.call("node_metrics"), m.device,
+                    baseline=baselines.get(m.name))
             # lint: allow(no-silent-except) sweep tooling: a dead member costs its stamp, not the whole sweep; not a production verify/notarise path
             except Exception:
                 pass  # a dead member costs its stamp, not the sweep

@@ -35,6 +35,7 @@ def _real_group_commit_stamp(tmp_path) -> dict:
     for i in range(3):
         member.submit(cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
     member.flush_appends()
+    member.quiesce_apply()  # pipelined plane: fold executor results back
     assert all(member.decided[b"r%d" % i].ok for i in range(3))
     return member.stamp()
 
@@ -110,6 +111,52 @@ def test_raft_bench_section_emits_replication_stamps(tmp_path, monkeypatch,
     # And the latency table is intact next to them (first rung of the
     # round-15 ladder — the vectorized ingest plane raised the defaults).
     assert section["rates"]["60_tx_s"]["p99_ms"] == 20.0
+
+
+def test_sub_min_rounds_pipelined_window_abstains_not_stale_rounds():
+    """Round 18 abstention fix: a short pipelined leg delta-windowed
+    against its warmup baseline must report first_bottleneck None — not
+    the stale "rounds" verdict carried over from the cumulative
+    counters of earlier (serial) legs."""
+    from corda_tpu.obs import doctor as _doctor
+
+    cumulative = {
+        "verifier": "cpu",
+        "raft": {"pipeline": True, "role": "leader"},
+        # 100 cumulative rounds, pump-dominated — earlier legs' shape.
+        "round_stage_s": {"rounds": 100, "pump": 3.0, "fsync": 0.2},
+    }
+    baseline = {"round_stage_s": {"rounds": 88, "pump": 2.99,
+                                  "fsync": 0.05}}
+    stale = _member_stamp(cumulative, "cpu")
+    assert stale["busiest_stage"] == "pump"  # the carryover trap
+
+    windowed = _member_stamp(cumulative, "cpu", baseline=baseline)
+    # 12-round window < MIN_ATTRIBUTION_ROUNDS: honest abstention.
+    assert windowed["busiest_stage"] is None
+    sweep = SweepResult(
+        results={}, node_stamps={"Raft0": windowed},
+        doctor=_doctor.stamp_attribution({"Raft0": windowed}))
+    assert sweep.first_bottleneck is None
+
+
+def test_delta_window_reattributes_away_from_warmup_shape():
+    """With enough rounds in the window, the delta stamp names what the
+    MEASURED leg was bound by, not what warmup was."""
+    cumulative = {"round_stage_s": {"rounds": 100, "pump": 3.0,
+                                    "fsync": 1.5}}
+    baseline = {"round_stage_s": {"rounds": 40, "pump": 2.99,
+                                  "fsync": 0.1}}
+    assert _member_stamp(cumulative, "cpu")["busiest_stage"] == "pump"
+    windowed = _member_stamp(cumulative, "cpu", baseline=baseline)
+    # 60-round window: pump delta is 0.01s, fsync delta is 1.4s.
+    assert windowed["busiest_stage"] == "fsync"
+    # Counter resets (member restart mid-sweep) clamp to zero, never
+    # negative wall time.
+    reset = _member_stamp(
+        {"round_stage_s": {"rounds": 25, "pump": 0.5}}, "cpu",
+        baseline={"round_stage_s": {"rounds": 0, "pump": 2.0}})
+    assert reset["busiest_stage"] is None
 
 
 def test_replication_summary_prefers_leader_then_busiest(tmp_path):

@@ -614,6 +614,71 @@ def test_ingest_sweep_report_contract(monkeypatch):
     assert out["chaos"]["exactly_once"] is True
 
 
+def test_ingest_sweep_pipeline_delta_contract(monkeypatch):
+    """Round 18: after the chaos leg the section runs a serial-vs-
+    pipelined raft A/B at one rate and reports the committed-tx/s delta
+    — the number `perfdoctor --gate` regresses on. Both legs must pin
+    notary="raft" (the delta is about the commit plane, not the simple
+    notary) and differ ONLY in the [raft] pipeline flag."""
+    from corda_tpu.tools import loadtest
+
+    calls = []
+
+    def fake_sweep(**kw):
+        calls.append(kw)
+        if kw.get("chaos"):
+            return loadtest.SweepResult(
+                results={1200.0: _fake_ingest_row(1200.0)}, node_stamps={})
+        rate = kw["rates"][0]
+        # The pipelined leg commits 2.5x the serial leg's throughput.
+        achieved = rate * (2.0 if kw.get("pipeline", True) else 0.8)
+        return loadtest.SweepResult(
+            results={r: _fake_ingest_row(r, achieved=achieved)
+                     for r in kw["rates"]},
+            node_stamps={})
+
+    monkeypatch.setattr(loadtest, "run_ingest_sweep", fake_sweep)
+    out = bench.bench_ingest_sweep(rates=(1200.0,))
+    json.dumps(out)
+
+    # Main ladder + chaos leg first, then the two delta legs.
+    assert calls[1]["chaos"] == "lossy"
+    serial_kw, piped_kw = calls[2], calls[3]
+    assert serial_kw["pipeline"] is False and piped_kw["pipeline"] is True
+    for kw in (serial_kw, piped_kw):
+        assert kw["notary"] == "raft"
+        assert kw["rates"] == (2400.0,)
+
+    delta = out["pipeline_delta"]
+    assert delta["notary"] == "raft"
+    assert delta["rate_tx_s"] == 2400.0
+    assert delta["committed_tx_s_serial"] == 1920.0
+    assert delta["committed_tx_s_pipelined"] == 4800.0
+    assert delta["pipeline_speedup"] == 2.5
+    assert delta["exactly_once_both"] is True
+
+
+def test_ingest_sweep_pipeline_delta_crash_costs_only_its_key(monkeypatch):
+    from corda_tpu.tools import loadtest
+
+    def fake_sweep(**kw):
+        if "pipeline" in kw:
+            raise RuntimeError("delta leg worker died")
+        if kw.get("chaos"):
+            return loadtest.SweepResult(
+                results={1200.0: _fake_ingest_row(1200.0)}, node_stamps={})
+        return loadtest.SweepResult(
+            results={r: _fake_ingest_row(r) for r in kw["rates"]},
+            node_stamps={})
+
+    monkeypatch.setattr(loadtest, "run_ingest_sweep", fake_sweep)
+    out = bench.bench_ingest_sweep(rates=(1200.0,))
+    json.dumps(out)
+    assert "RuntimeError" in out["pipeline_delta"]["error"]
+    assert out["chaos"]["exactly_once"] is True  # earlier legs unharmed
+    assert out["peak_achieved_tx_s"] == 960.0
+
+
 def test_ingest_sweep_report_isolates_subrun_errors(monkeypatch):
     """One failed rate (dead worker, timeout) records an error row and the
     later rates still report; headline aggregates come from the rates that

@@ -146,6 +146,29 @@ def test_leader_kill_exactly_once_with_measured_recovery(tmp_path):
 
 
 @pytest.mark.slow
+def test_pipelined_leader_kill_mid_overlap_exactly_once(tmp_path):
+    """Round 18 chaos leg: with the pipelined commit plane on (the
+    default), stall every fsync so sealed-but-uncommitted rounds pile up
+    behind the replicating one, then kill the leader mid-burst — the
+    kill lands while rounds N and N+1 genuinely overlap. Redelivered
+    replies after the crash must stay idempotent: exactly once, nothing
+    lost, nothing doubled."""
+    from corda_tpu.tools.loadtest import run_chaos_loadtest
+
+    plan = faults.FaultPlan(7, [
+        faults.FaultRule("raft.fsync", "stall", delay_s=0.02)])
+    result = run_chaos_loadtest(
+        plan=plan, n_tx=60, kill_leader=True, rate_tx_s=200.0,
+        base_dir=str(tmp_path), max_seconds=120.0)
+    assert any("killed leader" in d for d in result.disruptions), \
+        result.disruptions
+    assert result.faults_injected.get("raft.fsync:stall", 0) > 0
+    assert result.exactly_once, result.to_json()
+    assert result.cluster_committed == 60
+    assert result.leader_kill_recovery_s is not None
+
+
+@pytest.mark.slow
 def test_lossy_transport_redelivers_to_completion(tmp_path):
     from corda_tpu.tools.loadtest import run_chaos_loadtest
 

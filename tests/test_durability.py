@@ -97,6 +97,36 @@ def test_crc32c_reference_vector():
         b"6789", _integrity.crc32c(b"12345")) == 0xE3069283
 
 
+def test_committed_crc_many_matches_scalar_python_path():
+    """Round 18 columnar commit: the batched CRC — native _ccommit when
+    the toolchain built it, pure-Python fallback otherwise — must be
+    bit-identical to the scalar ``committed_crc`` the scrubber verifies
+    rows against. A divergence would make every pipelined commit look
+    corrupt on the next scrub pass."""
+    import random
+
+    rng = random.Random(0x18)
+    pairs = [(b"123456789", b"")]  # the RFC 3720 check value seeds chain
+    pairs += [(rng.randbytes(rng.randrange(1, 64)),
+               rng.randbytes(rng.randrange(1, 64))) for _ in range(64)]
+    got = _integrity.committed_crc_many(pairs)
+    assert got == [_integrity.committed_crc(r, c) for r, c in pairs]
+    assert _integrity.committed_crc_many([]) == []
+
+
+def test_committed_crc_many_python_fallback_parity(monkeypatch):
+    """Force the pure-Python leg and (when available) compare it against
+    the native core directly — the two implementations must agree on the
+    same batch regardless of which one ``_load_ccommit`` picked."""
+    pairs = [(b"ref-%d" % i, b"tx-%d" % (i % 3)) for i in range(17)]
+    native = _integrity._load_ccommit()
+    monkeypatch.setattr(_integrity, "_ccommit", False)  # fallback leg
+    fallback = _integrity.committed_crc_many(pairs)
+    assert fallback == [_integrity.committed_crc(r, c) for r, c in pairs]
+    if native:
+        assert list(native.committed_crc_many(pairs)) == fallback
+
+
 def test_log_crc_binds_index_term_and_bytes():
     base = _integrity.log_crc(7, 3, b"entry")
     assert _integrity.log_crc(8, 3, b"entry") != base

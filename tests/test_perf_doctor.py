@@ -196,6 +196,52 @@ def test_unknown_stage_gets_generic_suggestion():
     assert "wire_decode" in verdict["bottlenecks"][0]["next_experiment"]
 
 
+def test_pipelined_rounds_verdict_suggests_executor_levers():
+    """Round 18: a "rounds" verdict from members stamping pipeline=true
+    must suggest the NEXT experiment (apply-queue depth / native
+    commit_many sweep) — re-suggesting round-loop amortization the
+    pipelined plane has already applied would send the operator in a
+    circle."""
+    stamps = {"Raft0": {"busiest_stage": "rounds",
+                        "raft": {"pipeline": True, "role": "leader"}},
+              "Raft1": {"busiest_stage": "rounds",
+                        "raft": {"pipeline": True, "role": "follower"}}}
+    verdict = doctor.stamp_attribution(stamps)
+    assert verdict["first_bottleneck"] == "rounds"
+    top = verdict["bottlenecks"][0]
+    assert "apply_queue_depth" in top["next_experiment"]
+    assert "commit_many" in top["next_experiment"]
+    assert "amortize" not in top["next_experiment"]
+
+
+def test_serial_rounds_verdict_keeps_round_loop_amortization_rule():
+    stamps = {"Raft0": {"busiest_stage": "rounds",
+                        "raft": {"pipeline": False}}}
+    verdict = doctor.stamp_attribution(stamps)
+    assert verdict["first_bottleneck"] == "rounds"
+    top = verdict["bottlenecks"][0]
+    # The serial loop still gets the amortization suggestion verbatim.
+    assert top["next_experiment"] == doctor.RULES["rounds"]
+    assert "apply_queue_depth" not in top["next_experiment"]
+
+
+def test_pipelined_dominant_apply_phase_maps_to_executor_rule():
+    stamps = {"Raft0": {"raft": {"pipeline": True},
+                        "round_breakdown": _breakdown(
+                            {"apply": 0.6, "seal": 0.1, "poll": 0.1})}}
+    verdict = doctor.stamp_attribution(stamps)
+    assert verdict["first_bottleneck"] == "apply"
+    top = verdict["bottlenecks"][0]
+    assert "apply_queue_depth" in top["next_experiment"]
+    assert "commit_many" in top["next_experiment"]
+    # The same breakdown WITHOUT the pipeline stamp keeps the serial rule.
+    serial = doctor.stamp_attribution(
+        {"Raft0": {"round_breakdown": _breakdown(
+            {"apply": 0.6, "seal": 0.1, "poll": 0.1})}})
+    assert serial["bottlenecks"][0]["next_experiment"] \
+        == doctor.RULES["apply"]
+
+
 def test_stamp_attribution_empty_and_scalar_polluted_stamps():
     assert doctor.stamp_attribution({})["first_bottleneck"] is None
     assert doctor.stamp_attribution(None)["first_bottleneck"] is None

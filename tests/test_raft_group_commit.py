@@ -95,9 +95,17 @@ def elect(net, member, t):
 
 
 def settle(net, members, rounds=6):
+    """Drive every member's round loop to quiescence. With the pipelined
+    commit plane (round 18) state-apply runs on each member's executor
+    thread, so each round must also quiesce the apply queues — and then
+    deliver again, because draining results is what emits the coalesced
+    ClientReply frames."""
     for _ in range(rounds):
         for m in members:
             m.flush_appends()
+        net.deliver_all()
+        for m in members:
+            m.quiesce_apply()
         net.deliver_all()
 
 
@@ -306,6 +314,7 @@ def test_group_commit_off_keeps_per_command_sync_path(tmp_path):
     assert all(isinstance(deserialize(bytes(b)), PutAllCommand)
                for (b,) in rows)
     member.flush_appends()
+    member.quiesce_apply()
     for i in range(3):
         assert member.decided[b"r%d" % i].ok is True
     stamp = member.stamp()
@@ -323,6 +332,7 @@ def test_single_member_group_commit_and_stamp(tmp_path):
     for i in range(4):
         member.submit(cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
     member.flush_appends()
+    member.quiesce_apply()
     assert all(member.decided[b"r%d" % i].ok for i in range(4))
     stamp = member.stamp()
     assert stamp["entries_per_batch"] == 4.0
@@ -357,6 +367,278 @@ def test_node_metrics_carries_raft_and_transport_stamps(tmp_path):
         json.dumps(metrics["transport"])
     finally:
         node.stop()
+
+
+# ---------------------------------------------------------------------------
+# Pipelined commit plane (round 18): overlapped rounds, detached apply
+# executor, bounded-queue backpressure, serial-path bit-parity.
+# ---------------------------------------------------------------------------
+
+
+def _ledger_rows(member):
+    return [(bytes(r[0]), bytes(r[1]), r[2]) for r in member.db.conn.execute(
+        "SELECT state_ref, consuming, crc FROM committed_states "
+        "ORDER BY state_ref").fetchall()]
+
+
+def test_pipeline_off_serial_path_bit_identical(tmp_path):
+    """[raft] pipeline=false preserves the serial apply path, and the
+    pipelined plane (executor + columnar commit_many) produces the SAME
+    bytes: identical decided outcomes per request AND identical
+    committed_states rows — state_ref, consuming blob and CRC32C all
+    bit-for-bit, conflicts included."""
+    outcomes, ledgers = {}, {}
+    for label, config in (("serial", RaftConfig(pipeline=False)),
+                          ("pipelined", RaftConfig())):
+        net, t = Net(), [0.0]
+        member = make_member(tmp_path, net, f"A{label}", {}, lambda: t[0],
+                             config=config)
+        elect(net, member, t)
+        shared = StateRef(SecureHash.sha256(b"dup"), 0)
+        batch = [
+            PutAllCommand((shared,), SecureHash.sha256(b"w1"), PARTY, b"p1"),
+            PutAllCommand((shared,), SecureHash.sha256(b"w2"), PARTY, b"p2"),
+            cmd(b"f1", b"w3", b"p3"),
+            cmd(b"f2", b"w4", b"p4"),
+        ]
+        for c in batch:
+            member.submit(c)
+        member.flush_appends()
+        member.quiesce_apply()
+        outcomes[label] = {
+            rid: (member.decided[rid].ok,
+                  member.decided[rid].conflict is not None)
+            for rid in (b"p1", b"p2", b"p3", b"p4")}
+        ledgers[label] = _ledger_rows(member)
+        stamp = member.stamp()
+        assert stamp["pipeline"] is (label == "pipelined")
+        if label == "pipelined":
+            assert stamp["apply_batches"] >= 1
+            assert stamp["apply_backlog"] == 0
+        json.dumps(stamp)
+    assert outcomes["serial"] == outcomes["pipelined"]
+    assert outcomes["serial"][b"p1"] == (True, False)
+    assert outcomes["serial"][b"p2"] == (False, True)  # conflict isolated
+    assert ledgers["serial"] == ledgers["pipelined"]
+
+
+def test_midround_seal_overlaps_replicating_round(tmp_path):
+    """Pipelined rounds: a full append_chunk of buffered commands seals
+    and broadcasts MID-ROUND — round N+1's entry enters the log (and the
+    per-peer stream) while round N's entries are still un-acked."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0],
+                        config=RaftConfig(append_chunk=2, pipeline_window=64))
+    leader = members["A"]
+    elect(net, leader, t)
+    for i in range(5):
+        leader.submit(cmd(b"s%d" % i, b"t%d" % i, b"r%d" % i))
+    # append_chunk=2: submissions 2 and 4 sealed their rounds mid-flight;
+    # nothing has been delivered, so BOTH sealed entries are ahead of the
+    # commit index — the overlap the serial loop never had.
+    assert leader.metrics["midround_seals"] == 2
+    (log_len,) = leader.db.conn.execute(
+        "SELECT COUNT(*) FROM raft_log").fetchone()
+    assert log_len == 2 and leader.commit_index == 0
+    leader.flush_appends()  # the round closes: the tail (r4) seals too
+    settle(net, members.values())
+    for i in range(5):
+        assert leader.decided[b"r%d" % i].ok is True
+    for m in members.values():
+        assert m.last_applied == leader.last_applied
+        (n,) = m.db.conn.execute(
+            "SELECT COUNT(*) FROM committed_states").fetchone()
+        assert n == 5
+    assert leader.stamp()["midround_seals"] == 2
+
+
+def test_leader_kill_mid_overlap_commits_exactly_once(tmp_path):
+    """Leader dies with round N replicated-but-unacked and round N+1
+    sealed right behind it. The survivors elect a new leader holding both
+    entries; the clients' resubmissions ride the new leader as DUPLICATE
+    log entries — and the apply plane's request/tx idempotence (same-tx
+    re-commit is success, INSERT OR IGNORE) keeps the ledger exactly-once:
+    one consuming row per state ref."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])
+    old = members["A"]
+    elect(net, old, t)
+    c1, c2 = cmd(b"s1", b"t1", b"r1"), cmd(b"s2", b"t2", b"r2")
+    old.submit(c1)
+    old.flush_appends()   # round N sealed + broadcast, acks in flight
+    old.submit(c2)
+    old.flush_appends()   # round N+1 sealed mid-overlap
+    del net.handlers["A"]  # the kill: A never processes another frame
+    net.deliver_all()      # followers persist both entries, acks go dark
+    new = members["B"]
+    elect(net, new, t)     # B leads, holding both un-committed entries
+    survivors = [members["B"], members["C"]]
+    # The clients' retry path resubmits through the new leader.
+    new.submit(c1)
+    new.submit(c2)
+    new.flush_appends()
+    settle(net, survivors)
+    assert new.decided[b"r1"].ok is True
+    assert new.decided[b"r2"].ok is True
+    for m in survivors:
+        rows = _ledger_rows(m)
+        assert len(rows) == 2  # one consuming row per ref: exactly once
+        assert len({r[0] for r in rows}) == 2
+    assert _ledger_rows(survivors[0]) == _ledger_rows(survivors[1])
+
+
+def test_apply_queue_backpressure_sheds_new_submissions(tmp_path):
+    """Bounded commit queue at depth 1 with the executor parked inside an
+    apply: NEW submissions shed with the retryable bounce (ok=False,
+    conflict=None) and the provider's admission point raises
+    CommitQueueFullException — while in-flight commands are never shed and
+    drain to success once the executor resumes."""
+    import threading
+
+    from corda_tpu.node.services.raft import (
+        CommitQueueFullException,
+        RaftUniquenessProvider,
+    )
+
+    net, t = Net(), [0.0]
+    member = make_member(tmp_path, net, "A", {}, lambda: t[0],
+                         config=RaftConfig(apply_queue_depth=1))
+    elect(net, member, t)
+    started, gate = threading.Event(), threading.Event()
+    orig = member.apply_command
+    member._commit_many = None  # route every command through `slow`
+
+    def slow(c):
+        started.set()
+        assert gate.wait(5.0)
+        return orig(c)
+
+    member.apply_command = slow
+    member.submit(cmd(b"s1", b"t1", b"r1"))
+    member.flush_appends()       # entry 1 enqueued; executor picks it up
+    assert started.wait(5.0)     # executor parked inside the apply
+    member.submit(cmd(b"s2", b"t2", b"r2"))
+    member.flush_appends()       # entry 2 fills the depth-1 queue
+    assert member.apply_overloaded()
+    assert member.apply_backlog() == 2
+    member.submit(cmd(b"s3", b"t3", b"r3"))  # shed: retryable bounce
+    assert member.decided[b"r3"].ok is False
+    assert member.decided[b"r3"].conflict is None
+    assert member.metrics["apply_shed"] == 1
+    # The provider's poll sheds NOT-in-flight (re)submissions loudly.
+    provider = RaftUniquenessProvider(member, pump=lambda: None)
+    poll = provider.commit_async(
+        (StateRef(SecureHash.sha256(b"s4"), 0),),
+        SecureHash.sha256(b"t4"), PARTY)
+    try:
+        poll()
+        raise AssertionError("expected CommitQueueFullException")
+    except CommitQueueFullException:
+        pass
+    gate.set()                   # executor resumes: committed work drains
+    member.quiesce_apply()
+    assert member.decided[b"r1"].ok is True
+    assert member.decided[b"r2"].ok is True
+    stamp = member.stamp()
+    assert stamp["apply_shed"] == 1
+    assert stamp["apply_queue_depth"] == 1
+    json.dumps(stamp)
+
+
+def test_commit_queue_full_maps_to_retryable_overload_error(tmp_path):
+    """The notary flow surfaces CommitQueueFullException as the SAME
+    retryable OverloadedError the QoS admission plane uses (lane
+    "commit"), so notarise_with_retry's shed-backoff handling covers the
+    pipelined apply executor's admission point too."""
+    from corda_tpu.flows.notary import (
+        NotaryException,
+        NotaryServiceFlow,
+        OverloadedError,
+    )
+    from corda_tpu.node.services.raft import CommitQueueFullException
+
+    class FullProvider:  # sync provider shape: no commit_async attr
+        def commit(self, states, tx_id, caller):
+            raise CommitQueueFullException("commit queue full")
+
+    flow = NotaryServiceFlow.__new__(NotaryServiceFlow)
+    flow.service = types.SimpleNamespace(uniqueness_provider=FullProvider())
+    wtx = types.SimpleNamespace(inputs=(), id=SecureHash.sha256(b"tx"))
+    try:
+        list(flow._commit_input_states(wtx, PARTY))
+        raise AssertionError("expected NotaryException")
+    except NotaryException as e:
+        assert isinstance(e.error, OverloadedError)
+        assert e.error.lane == "commit"
+        assert e.error.retry_after_ms == CommitQueueFullException.RETRY_AFTER_MS
+
+
+def test_executor_crash_resets_and_reapplies_idempotently(tmp_path):
+    """An apply exception on the executor surfaces on the consensus
+    thread exactly like the serial path's, the executor resets, and the
+    failed entry re-applies idempotently from the durable log through a
+    fresh executor — no decision lost, no double-commit."""
+    net, t = Net(), [0.0]
+    member = make_member(tmp_path, net, "A", {}, lambda: t[0])
+    elect(net, member, t)
+    orig = member.apply_command
+    member._commit_many = None
+    boom = {"armed": True}
+
+    def flaky(c):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("disk hiccup")
+        return orig(c)
+
+    member.apply_command = flaky
+    member.submit(cmd(b"s1", b"t1", b"r1"))
+    member.flush_appends()
+    try:
+        member.quiesce_apply()
+        raise AssertionError("expected the executor's error to surface")
+    except RuntimeError:
+        pass
+    assert member._apply_queue is None  # reset: fresh executor next tick
+    assert member.last_applied == 0
+    member.tick()  # re-enqueues the committed entry
+    member.quiesce_apply()
+    assert member.decided[b"r1"].ok is True
+    assert member.last_applied == 1
+    assert len(_ledger_rows(member)) == 1
+
+
+def test_sustained_pipelined_load_serializes_settings_writes(tmp_path):
+    """Sustained load with the executor genuinely concurrent: the
+    consensus thread folds results (raft_commit_index/raft_last_applied
+    settings writes) while the executor is mid-transaction applying the
+    NEXT entry on the SAME sqlite connection. Before those writes went
+    under db.lock this raced into `cannot start a transaction within a
+    transaction` within a few rounds — and throughput is the acceptance
+    number: the pipelined plane must clear 2k committed tx/s per group."""
+    import time as _wall
+
+    net, t = Net(), [0.0]
+    member = make_member(tmp_path, net, "A", {}, lambda: t[0])
+    elect(net, member, t)
+    n = 4096
+    t0 = _wall.perf_counter()
+    for i in range(n):
+        member.submit(cmd(b"s%05d" % i, b"t%05d" % i, b"r%05d" % i))
+        if i % 128 == 127:
+            member.flush_appends()
+    member.flush_appends()
+    member.quiesce_apply()
+    dt = _wall.perf_counter() - t0
+    assert member.last_applied == member.commit_index
+    assert len(_ledger_rows(member)) == n  # every command exactly once
+    assert member.metrics["apply_batches"] >= 1
+    # Durable watermarks match memory after the fold.
+    assert member.db.get_setting("raft_last_applied") == str(
+        member.last_applied)
+    # ~9k tx/s on the CI container; 2000 leaves slack for slow runners
+    # while still failing hard if the plane ever re-serializes.
+    assert n / dt > 2000, f"pipelined commit plane at {n / dt:.0f} tx/s"
 
 
 def test_append_many_crash_consistency_full_replay(tmp_path):
