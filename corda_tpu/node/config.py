@@ -62,6 +62,13 @@ class BatchConfig:
     # served a run; the server's --devices flag is authoritative). 0 =
     # unknown/single-device.
     sidecar_devices: int = 0
+    # Federated verify plane (crypto/federation.py): comma-separated
+    # addresses of PER-HOST sidecar servers. When set, the node routes
+    # verify batches across every listed host by queue depth + QoS lane
+    # (hedged re-dispatch, per-host degrade/re-admit) instead of feeding
+    # one host-local server; takes precedence over `sidecar`. "" disables
+    # federation: verification routes exactly as before.
+    federation_hosts: str = ""
 
 
 @dataclass(frozen=True)
@@ -274,6 +281,13 @@ class NodeConfig:
                 sidecar_deadline_ms=float(
                     batch.get("sidecar_deadline_ms", 2000.0)),
                 sidecar_devices=int(batch.get("sidecar_devices", 0)),
+                # Accept a TOML list or the comma-joined string the env
+                # var uses; normalise to the string form.
+                federation_hosts=(
+                    ",".join(str(h) for h in batch["federation_hosts"])
+                    if isinstance(batch.get("federation_hosts"),
+                                  (list, tuple))
+                    else str(batch.get("federation_hosts", ""))),
             ),
             raft=RaftConfig(
                 group_commit=bool(raft.get("group_commit", True)),

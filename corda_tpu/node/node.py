@@ -66,6 +66,38 @@ def _make_verifier(kind: str):
     return make_verifier(kind)
 
 
+def _select_batch_verifier(config: NodeConfig):
+    """Pick the node's verification provider from config + env.
+
+    Precedence: federation_hosts (or CORDA_TPU_FEDERATION) — the multi-
+    host router over per-host sidecars (crypto/federation.py) — then a
+    single sidecar address (or CORDA_TPU_SIDECAR), then the local
+    provider. Module-level so the federation-off bit-identity contract
+    is testable without booting a node: with neither knob set this
+    returns exactly what the pre-federation tree built.
+    """
+    federation = config.batch.federation_hosts or os.environ.get(
+        "CORDA_TPU_FEDERATION", "")
+    if federation:
+        from ..crypto.federation import FederatedVerifier
+
+        hosts = [h.strip() for h in federation.split(",") if h.strip()]
+        return FederatedVerifier(
+            hosts,
+            deadline_ms=config.batch.sidecar_deadline_ms,
+            devices=config.batch.sidecar_devices or None)
+    sidecar_addr = config.batch.sidecar or os.environ.get(
+        "CORDA_TPU_SIDECAR", "")
+    if sidecar_addr:
+        from .verify_client import SidecarVerifier
+
+        return SidecarVerifier(
+            sidecar_addr,
+            deadline_ms=config.batch.sidecar_deadline_ms,
+            devices=config.batch.sidecar_devices or None)
+    return _make_verifier(config.verifier)
+
+
 class Node:
     """One process-owning node instance over a base_dir."""
 
@@ -203,22 +235,11 @@ class Node:
         self.metrics_history: deque[dict] = deque(
             maxlen=self.METRICS_HISTORY_KEEP)
 
-        # Verification provider: a configured sidecar address (or the
-        # CORDA_TPU_SIDECAR env the driver plants) swaps in the sidecar
-        # client so this process feeds the host's shared device-owning
-        # server (crypto/sidecar.py). Unset = exactly the local routing
-        # as before.
-        sidecar_addr = config.batch.sidecar or os.environ.get(
-            "CORDA_TPU_SIDECAR", "")
-        if sidecar_addr:
-            from .verify_client import SidecarVerifier
-
-            verifier = SidecarVerifier(
-                sidecar_addr,
-                deadline_ms=config.batch.sidecar_deadline_ms,
-                devices=config.batch.sidecar_devices or None)
-        else:
-            verifier = _make_verifier(config.verifier)
+        # Verification provider: federation_hosts routes batches across
+        # per-host sidecars; a single sidecar address feeds the host's
+        # shared device-owning server (crypto/sidecar.py). Neither set =
+        # exactly the local routing as before.
+        verifier = _select_batch_verifier(config)
 
         # -- state machine manager ----------------------------------------
         self.smm = StateMachineManager(

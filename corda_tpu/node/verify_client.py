@@ -75,8 +75,11 @@ class SidecarVerifier(DeviceRoutedVerifier):
         # sidecar_devices): stamped for attribution; the server snapshot
         # below carries the proven value.
         self.devices = devices or None
-        self._server_snapshot: dict | None = None
-        self._server_snapshot_t = 0.0
+        # Server-stats cache keyed BY ENDPOINT, not a single slot: the
+        # federation router (crypto/federation.py) holds one client per
+        # host, and any address change (or a future shared cache) must
+        # never serve one sidecar's stale snapshot as another's.
+        self._server_snapshots: dict[str, tuple[float, dict | None]] = {}
         self._sock: socket.socket | None = None
         self._req_id = 0
         # Serialises the socket: the feeder thread and the degrade
@@ -252,18 +255,18 @@ class SidecarVerifier(DeviceRoutedVerifier):
         """Best-effort server-side snapshot (per-device occupancy, pad
         fraction, mesh size) riding the client stamp into node_metrics —
         fetched over a FRESH connection so it never contends with an
-        in-flight verify, cached 5 s so metrics polls stay cheap, and None
-        (never an exception) when the server is unreachable."""
+        in-flight verify, cached 5 s PER ENDPOINT so metrics polls stay
+        cheap without one sidecar's snapshot masquerading as another's,
+        and None (never an exception) when the server is unreachable."""
         now = time.monotonic()
-        if (self._server_snapshot is not None
-                and now - self._server_snapshot_t < 5.0):
-            return self._server_snapshot
+        hit = self._server_snapshots.get(self.address)
+        if hit is not None and now - hit[0] < 5.0:
+            return hit[1]
         try:
             snap = fetch_sidecar_stats(self.address, timeout=0.5)
         except SidecarError:
             snap = None
-        self._server_snapshot = snap
-        self._server_snapshot_t = now
+        self._server_snapshots[self.address] = (now, snap)
         return snap
 
 

@@ -94,6 +94,11 @@ _PHASE_DOMINANT_SHARE = 0.3
 # Sheds below this fraction of admission decisions are the controller
 # doing its job; above it the rates are mis-calibrated for the load.
 _SHED_DOMINATED = 0.2
+# Federation routing-share skew (max share - min share across hosts)
+# worth flagging: below this the router's depth balancing is doing its
+# job; above it one host is soaking the traffic — a slow host attracting
+# hedged re-dispatches, or a depth signal gone stale.
+_HOST_IMBALANCE_SKEW = 0.25
 
 # ---------------------------------------------------------------------------
 # The rule table: cause -> the suggested next experiment. Causes either
@@ -150,6 +155,13 @@ RULES: dict = {
         "the verify stage dominates: raise device routing (sidecar "
         "cross-process coalescing, bucket ladder) so signatures leave "
         "the host tier"),
+    "host_imbalance": (
+        "rebalance weights / raise hedge threshold: the federation "
+        "router is concentrating verify traffic on a subset of hosts — "
+        "check occupancy_by_host for a slow host soaking hedged "
+        "re-dispatches, then rebalance the routing (drain/readmit the "
+        "slow host) or raise CORDA_TPU_FEDERATION_HEDGE_MS so hedges "
+        "stop amplifying the skew"),
 }
 
 _GENERIC_SUGGESTION = (
@@ -214,6 +226,44 @@ def _occupancy_of(stamp: dict) -> float | None:
     if isinstance(dev, int) and isinstance(host, int) and (dev + host):
         return dev / (dev + host)
     return None
+
+
+def _merge_federation(feds: list) -> dict | None:
+    """Fold per-member federation stamps (FederatedVerifier
+    ``federation_stats`` shape, riding each member's sidecar stamp) into
+    one routing view: per-host dispatch counts sum across members,
+    shares re-derive from the summed total, and each host's occupancy
+    comes from its own server snapshot. None below two hosts or zero
+    dispatches — no skew verdict without a real routing split."""
+    by_host: dict = {}
+    occ_by_host: dict = {}
+    hedges = degraded = 0
+    for f in feds:
+        if not isinstance(f, dict):
+            continue
+        hedges += int(_finite(f.get("hedges")) or 0)
+        degraded += int(_finite(f.get("host_degraded")) or 0)
+        for addr, ch in (f.get("hosts") or {}).items():
+            if not isinstance(ch, dict):
+                continue
+            by_host[addr] = (by_host.get(addr, 0)
+                             + int(_finite(ch.get("dispatches")) or 0))
+            server = ch.get("server")
+            if isinstance(server, dict) and addr not in occ_by_host:
+                occ = _occupancy_of(server)
+                if occ is not None:
+                    occ_by_host[addr] = round(occ, 3)
+    total = sum(by_host.values())
+    if not total or len(by_host) < 2:
+        return None
+    return {
+        "routing_share_by_host": {a: round(n / total, 4)
+                                  for a, n in sorted(by_host.items())},
+        "occupancy_by_host": occ_by_host or None,
+        "dispatches": total,
+        "hedges": hedges,
+        "host_degraded": degraded,
+    }
 
 
 def _merge_breakdowns(breakdowns: list) -> dict | None:
@@ -337,6 +387,27 @@ def _candidates(signals: dict) -> list[dict]:
                                      "shed_fraction": round(frac, 4)},
                         "next_experiment": _suggest("admission")})
 
+    # Rule: federation routing-share skew -> host rebalance. Evidence
+    # pairs each host's share of routed batches with that host's own
+    # server occupancy (a slow host both under-serves its share and
+    # attracts the hedged re-dispatches that deepen the skew).
+    fed = signals.get("federation") or {}
+    shares = fed.get("routing_share_by_host") or {}
+    if len(shares) >= 2:
+        skew = max(shares.values()) - min(shares.values())
+        if skew >= _HOST_IMBALANCE_SKEW:
+            out.append({"cause": "host_imbalance",
+                        "score": round(0.5 + 0.5 * min(1.0, skew), 4),
+                        "evidence": {
+                            "routing_share_by_host": {
+                                k: round(v, 4)
+                                for k, v in sorted(shares.items())},
+                            "occupancy_by_host":
+                                fed.get("occupancy_by_host"),
+                            "dispatches": fed.get("dispatches"),
+                            "hedges": fed.get("hedges")},
+                        "next_experiment": _suggest("host_imbalance")})
+
     # Deterministic ranking: score desc, then cause name — two equal
     # scores can't flap the verdict between runs.
     out.sort(key=lambda c: (-c["score"], c["cause"]))
@@ -388,6 +459,8 @@ def stamp_attribution(node_stamps: dict | None) -> dict:
         "round_breakdown": _merge_breakdowns(breakdowns),
         "admission": {"admitted": admitted, "shed": shed},
         "pipeline_enabled": _pipeline_enabled(stamps),
+        "federation": _merge_federation(
+            [(s.get("sidecar") or {}).get("federation") for s in stamps]),
     }
     bottlenecks = _candidates(signals)
     return {

@@ -37,8 +37,13 @@ __all__ = [
 
 # Batch-level stages: recorded once per batch, attributed to every trace in
 # attrs["member_traces"]. sidecar_wait/sidecar_verify DECOMPOSE
-# device_verify for sidecar-routed batches (crypto/sidecar.py).
-BATCH_STAGES = ("queue_wait", "device_verify", "sidecar_wait",
+# device_verify for sidecar-routed batches (crypto/sidecar.py);
+# federation_route/remote_verify decompose it one level further for
+# federation-routed batches (crypto/federation.py): the routing decision
+# and the winning host's full round trip, which CONTAINS that host's
+# sidecar_wait/sidecar_verify.
+BATCH_STAGES = ("queue_wait", "device_verify", "federation_route",
+                "remote_verify", "sidecar_wait",
                 "sidecar_verify", "raft_append", "fsync", "replication")
 
 # Per-trace measured stage spans. shard_reserve/shard_commit are the two
@@ -63,7 +68,8 @@ DERIVED_STAGES = ("reply",)
 # Full breakdown order the bench report presents.
 STAGES = ("admission_wait", "epoch_wait", "queue_wait", "lane_queue_wait",
           "verify_wait",
-          "device_verify", "sidecar_wait", "sidecar_verify",
+          "device_verify", "federation_route", "remote_verify",
+          "sidecar_wait", "sidecar_verify",
           "shard_reserve", "shard_commit",
           "raft_append", "fsync", "replication",
           "scrub", "repair", "reply")

@@ -113,6 +113,12 @@ COUNTER_NAMES = (
     "sidecar_requests_total",
     "sidecar_batches_total",
     "sidecar_sigs_total",
+    # Federation router (crypto/federation.py): batches dispatched to a
+    # host channel, hedged re-dispatches fired, and per-host quarantine
+    # events (a host demoted to its cooldown re-probe).
+    "federation_dispatches_total",
+    "federation_hedges_total",
+    "federation_host_degraded_total",
     # The recorder's own audit trail.
     "flight_dumps_total",
     # The performance doctor (obs/doctor.py, bench.bench_doctor):

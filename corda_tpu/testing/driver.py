@@ -514,6 +514,32 @@ class Driver:
             handle.wait_up()
         return handle
 
+    def start_federation(self, count: int = 2,
+                         name_prefix: str = "fedhost",
+                         verifier: str = "cpu", device: str = "cpu",
+                         coalesce_us: int = 2000, max_sigs: int = 4096,
+                         depth: int = 2, devices: int | None = None,
+                         env_extra: dict | None = None,
+                         wait: bool = True) -> list[SidecarProcess]:
+        """Spawn `count` sidecar servers as SIMULATED HOSTS for the
+        federated verify plane (crypto/federation.py) — each its own
+        process with its own socket, scheduler and (virtual) device mesh,
+        so cross-host routing/hedging/degrade runs on one box. Point
+        nodes at the tier by joining the returned handles' addresses with
+        "," into `[batch] federation_hosts` (or CORDA_TPU_FEDERATION in
+        env_extra). Kill any one handle to exercise the per-host
+        quarantine → re-probe → re-admit path."""
+        handles = [
+            self.start_sidecar(
+                name=f"{name_prefix}{i}", verifier=verifier, device=device,
+                coalesce_us=coalesce_us, max_sigs=max_sigs, depth=depth,
+                devices=devices, env_extra=env_extra, wait=False)
+            for i in range(count)]
+        if wait:
+            for h in handles:
+                h.wait_up()
+        return handles
+
     def restart_node(self, handle: NodeProcess,
                      wait: bool = True) -> NodeProcess:
         """Re-spawn a (killed) node over its existing base_dir + config —

@@ -1052,6 +1052,12 @@ def _member_stamp(metrics: dict, device: str,
             "sidecar_per_device_occupancy": (
                 ((metrics.get("sidecar") or {}).get("server")
                  or {}).get("per_device_occupancy")),
+            # Federation ROUTER stamps (crypto/federation.py): per-host
+            # routing shares / hedges / degrade counters, hoisted flat so
+            # doctor.stamp_attribution's host_imbalance rule (and artifact
+            # greps) reach them without digging through the sidecar stamp.
+            # None when this member feeds a single sidecar or none.
+            "federation": ((metrics.get("sidecar") or {}).get("federation")),
             "async_verify": av or None,
             "pipeline_depth": av.get("depth"),
             "overlap_ratio": overlap,
@@ -1121,6 +1127,11 @@ def run_loadtest_multiprocess(
     adaptive_coalesce: bool = False,  # sidecar picks its own coalesce
     # window from observed arrival gaps (crypto/sidecar.py controller;
     # PR 7, off by default — flip per run to A/B against the static window)
+    federation_hosts: int = 0,  # > 0: spawn N host-local sidecars as
+    # simulated hosts and point every member's FederatedVerifier at the
+    # set (crypto/federation.py routes by queue depth + QoS lane, hedges
+    # slow hosts, quarantines dead ones). Mutually exclusive with
+    # `sidecar` — federation IS the multi-sidecar generalization.
     shards: int = 0,  # > 0: boot `shards` independent raft groups of
     # `cluster_size` members each, partitioned by StateRef hash
     # (node/services/sharding.py); requires a raft-flavoured `notary`
@@ -1140,15 +1151,22 @@ def run_loadtest_multiprocess(
     notary must not share one GIL')."""
     from ..testing.driver import driver
 
+    if federation_hosts and sidecar:
+        raise ValueError("federation_hosts and sidecar are mutually "
+                         "exclusive (federation IS the multi-sidecar "
+                         "generalization)")
     base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-mp-"))
-    def _extra(v: str, sidecar_addr: str = "") -> str:
+    def _extra(v: str, sidecar_addr: str = "",
+               federation_addrs: str = "") -> str:
         out = (f'verifier = "{v}"\n'
                f"[batch]\nmax_sigs = {max_sigs}\n"
                f"max_wait_ms = {max_wait_ms}\n"
                f"coalesce_ms = {coalesce_ms}\n"
                f"async_verify = {str(async_verify).lower()}\n"
                f"async_depth = {async_depth}\n")
-        if sidecar_addr:
+        if federation_addrs:
+            out += f"federation_hosts = {json.dumps(federation_addrs)}\n"
+        elif sidecar_addr:
             out += f"sidecar = {json.dumps(sidecar_addr)}\n"
             if sidecar_devices:
                 out += f"sidecar_devices = {int(sidecar_devices)}\n"
@@ -1164,6 +1182,7 @@ def run_loadtest_multiprocess(
     side_stats = None
     with driver(base) as d:
         side = None
+        fed_handles = []
         if sidecar:
             # The sidecar — not any member — owns the device: all members
             # ship micro-batches to it and it coalesces across processes.
@@ -1172,13 +1191,22 @@ def run_loadtest_multiprocess(
                 coalesce_us=sidecar_coalesce_us, max_sigs=max_sigs,
                 devices=sidecar_devices or None,
                 adaptive_coalesce=adaptive_coalesce, env_extra=trace_env)
+        elif federation_hosts:
+            # Federation tier: N host-local sidecars as simulated hosts;
+            # every member routes verify buckets across the set.
+            fed_handles = d.start_federation(
+                count=federation_hosts, verifier=verifier,
+                device=notary_device, coalesce_us=sidecar_coalesce_us,
+                max_sigs=max_sigs, devices=sidecar_devices or None,
+                env_extra=trace_env)
         side_addr = side.address if side is not None else ""
-        toml_extra = _extra(verifier, side_addr)
+        fed_addrs = ",".join(h.address for h in fed_handles)
+        toml_extra = _extra(verifier, side_addr, fed_addrs)
         # Followers stay on the host crypto path even when the leader runs
         # a device verifier: an election flip must degrade to host crypto,
         # not stall a cpu-pinned process behind an in-round XLA compile.
         # (With a sidecar, followers feed the same server instead.)
-        follower_extra = _extra("cpu", side_addr)
+        follower_extra = _extra("cpu", side_addr, fed_addrs)
         client_extra = _extra(client_verifier or verifier)
         if shards > 0:
             if not notary.startswith("raft"):
@@ -1330,6 +1358,19 @@ def run_loadtest_multiprocess(
                 side_stats = fetch_sidecar_stats(side.address)
             except SidecarError:
                 side_stats = {"error": "sidecar unreachable at gather"}
+        elif fed_handles:
+            # Per-host server view beside the members' client-side
+            # federation stamps (node_stamps[...]["federation"]).
+            from ..node.verify_client import SidecarError, fetch_sidecar_stats
+
+            servers: dict = {}
+            for h in fed_handles:
+                try:
+                    servers[h.address] = fetch_sidecar_stats(h.address)
+                except SidecarError:
+                    servers[h.address] = {
+                        "error": "host unreachable at gather"}
+            side_stats = {"federation_servers": servers}
         if trace:
             trace_file = _write_trace(
                 trace, _collect_trace_snapshots(rpcs + member_rpcs))
@@ -1883,6 +1924,7 @@ def run_slo_sweep(
                     # buffers, and their telemetry counters AT the breach.
                     spans: list = []
                     counters: dict = {}
+                    routing: dict = {}
                     for m, r in zip(members, member_rpcs):
                         try:
                             spans.extend(
@@ -1890,13 +1932,25 @@ def run_slo_sweep(
                             counters[m.name] = (
                                 (r.call("telemetry_snapshot").get("snapshot")
                                  or {}).get("counters"))
+                            # Federation routing state AT the breach:
+                            # per-host shares + the recent-decisions ring
+                            # (which host each batch went to and why), so
+                            # a breach on the federated plane is
+                            # attributable to a routing choice, not just
+                            # a latency number. Absent when the member
+                            # feeds a single sidecar or none.
+                            fed = ((r.call("node_metrics").get("sidecar")
+                                    or {}).get("federation"))
+                            if fed:
+                                routing[m.name] = fed
                         # lint: allow(no-silent-except) sweep tooling: a dead member costs its breach evidence, not the sweep; not a production verify/notarise path
                         except Exception:
                             pass
                     recorder.trigger("slo_breach", extra={
                         "rate_tx_s": float(rate), "slo_ms": float(slo_ms),
                         "interactive_p99_ms": inter.p99_ms,
-                        "member_counters": counters}, spans=spans)
+                        "member_counters": counters,
+                        "federation_routing": routing or None}, spans=spans)
         for m, r in zip(members, member_rpcs):
             try:
                 metrics = r.call("node_metrics")
@@ -2183,6 +2237,15 @@ def main(argv=None) -> int:
                          "and, on cpu hosts, forces a virtual device mesh "
                          "of that size so the data-parallel verify plane "
                          "is exercised end to end")
+    ap.add_argument("--federation-hosts", type=int, default=0,
+                    help="spawn N host-local verification sidecars as "
+                         "simulated hosts and point every notary member's "
+                         "FederatedVerifier at the set "
+                         "(crypto/federation.py: depth + QoS-lane routing, "
+                         "hedged re-dispatch, quarantine/re-admit; "
+                         "--processes mode, excludes --sidecar). A lost "
+                         "host degrades its in-flight batch to the local "
+                         "host tier — never a wrong answer")
     ap.add_argument("--shards", type=int, default=0,
                     help="boot N independent raft notary groups partitioned "
                          "by StateRef hash (--processes + raft notary); "
@@ -2224,6 +2287,13 @@ def main(argv=None) -> int:
     if args.sidecar_devices and not args.sidecar:
         ap.error("--sidecar-devices requires --sidecar (the mesh lives "
                  "inside the sidecar server)")
+    if args.federation_hosts:
+        if not args.processes:
+            ap.error("--federation-hosts requires --processes (each "
+                     "simulated host is a real sidecar OS process)")
+        if args.sidecar:
+            ap.error("--federation-hosts excludes --sidecar (federation "
+                     "IS the multi-sidecar generalization)")
     if args.lane and not args.processes:
         ap.error("--lane requires --processes (the QoS plane spans real "
                  "node processes; in-process mode has no lane plumbing)")
@@ -2279,6 +2349,7 @@ def main(argv=None) -> int:
             notary_device=args.notary_device,
             trace=args.trace, sidecar=args.sidecar,
             sidecar_devices=args.sidecar_devices,
+            federation_hosts=args.federation_hosts,
             shards=args.shards, cross_frac=args.cross_frac,
             lane=args.lane, slo_ms=args.slo_ms)
     else:

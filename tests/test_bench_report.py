@@ -26,6 +26,8 @@ def _stub_phases(monkeypatch):
                  "bench_validating_flagship",  # ditto: TWO flagship runs
                  "bench_shard_scaling",  # ditto: boots up to 4 raft groups
                  "bench_multichip_scaling",  # ditto: spawns 4 mesh sidecars
+                 "bench_multihost_scaling",  # ditto: spawns up to 4
+                 # federated sidecar hosts + a kill leg
                  "bench_slo_sweep",  # ditto: TWO full mixed-lane sweeps
                  "bench_ingest_sweep",  # ditto: builder + replay workers
                  "bench_telemetry",  # ditto: an in-process loadtest round
@@ -74,6 +76,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # mesh) AND the host-only path (virtual mesh) — same schema both ways.
     assert report["baseline_configs"]["multichip_scaling"] == {
         "stub": "bench_multichip_scaling"}
+    # The federated verify plane (round 19) rides the device phase path
+    # AND the host-only path — simulated hosts on both, same schema.
+    assert report["baseline_configs"]["multihost_scaling"] == {
+        "stub": "bench_multihost_scaling"}
     # The QoS SLO sweep rides the device phase path (sidecar-fed) — the
     # host-only path asserts it separately; schema parity both ways.
     assert report["baseline_configs"]["slo_sweep"] == {
@@ -156,6 +162,8 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
         "stub": "bench_shard_scaling"}
     assert report["baseline_configs"]["multichip_scaling"] == {
         "stub": "bench_multichip_scaling"}
+    assert report["baseline_configs"]["multihost_scaling"] == {
+        "stub": "bench_multihost_scaling"}
     assert report["baseline_configs"]["slo_sweep"] == {
         "stub": "bench_slo_sweep"}
     assert report["baseline_configs"]["ingest_sweep"] == {
@@ -447,6 +455,78 @@ def test_multichip_scaling_report_contract(monkeypatch):
     assert host["devices"]["4"] == {"error": "RuntimeError: mesh boot failed"}
     assert set(host["sigs_per_sec_by_devices"]) == {"1", "2"}
     assert "scaling_1_to_max" not in host  # max width errored: no ratio
+
+
+def test_multihost_scaling_report_contract(monkeypatch):
+    """The multihost_scaling section's one-line-JSON contract: one entry
+    per simulated-host count carrying parity-checked sigs/s + the
+    router's routing-share attribution, the flat sigs_per_sec_by_hosts
+    trend (monotone non-decreasing — the acceptance bar), the host-kill
+    leg's exactly_once audit, and per-width error isolation. Mirrors
+    multichip_scaling so trend tooling greps both the same way."""
+    calls = []
+
+    def fake_round(hosts, **kw):
+        calls.append((hosts, kw))
+        out = {"hosts": hosts, "n_sigs": kw.get("n_sigs", 16),
+               "workers": 2 * hosts, "batches": 40 * hosts,
+               "sigs_per_sec": 120.0 * hosts,  # near-linear
+               "p50_ms": 130.0, "p99_ms": 180.0, "parity_ok": True,
+               "fallbacks": 0, "hedges": 0, "host_degraded": 0,
+               "federation": {"routing_share_by_host": {
+                   f"h{i}": round(1.0 / hosts, 4) for i in range(hosts)}}}
+        if kw.get("kill_after_s") is not None:
+            out["host_kill"] = {"killed_host": "h0", "exactly_once": True,
+                                "answered_batches": 35,
+                                "post_kill_dispatches_by_host": [0, 15],
+                                "survivor_share_post_kill": 1.0,
+                                "host_degraded": 1, "local_fallbacks": 1}
+        return out
+
+    monkeypatch.setattr(bench, "_federation_round", fake_round)
+    out = bench.bench_multihost_scaling(host_counts=(1, 2, 4))
+    # The simulated-host disclosure is part of the schema: these numbers
+    # come from sidecar processes sharing one box, not a real pod.
+    assert out["mesh"] == "virtual-cpu"
+    assert out["simulated_hosts"] is True
+    assert set(out["hosts"]) == {"1", "2", "4"}
+    trend = [out["sigs_per_sec_by_hosts"][k] for k in ("1", "2", "4")]
+    assert trend == sorted(trend)  # monotone: the acceptance bar
+    assert out["scaling_1_to_max"] == 4.0  # >=1.7x@2, >=3x@4 passes
+    for section in out["hosts"].values():
+        assert section["parity_ok"] is True
+        assert "routing_share_by_host" in section["federation"]
+    # The kill leg ran on 2 hosts and its audit is hoisted to the top.
+    assert out["host_kill"]["exactly_once"] is True
+    assert out["host_kill"]["survivor_share_post_kill"] == 1.0
+    assert [h for h, _ in calls] == [1, 2, 4, 2]
+    assert calls[-1][1]["kill_after_s"] is not None
+
+    # One failing width must not take down the section — and a failed
+    # max width means no honest scaling ratio.
+    def flaky_round(hosts, **kw):
+        if hosts == 4:
+            raise RuntimeError("host boot failed")
+        return fake_round(hosts, **kw)
+
+    monkeypatch.setattr(bench, "_federation_round", flaky_round)
+    host = bench.bench_multihost_scaling(host_counts=(1, 2, 4),
+                                         kill_leg=False)
+    assert host["hosts"]["4"] == {"error": "RuntimeError: host boot failed"}
+    assert set(host["sigs_per_sec_by_hosts"]) == {"1", "2"}
+    assert "scaling_1_to_max" not in host
+    assert "host_kill" not in host
+
+    # A kill leg that dies mid-run is isolated the same way.
+    def kill_flaky(hosts, **kw):
+        if kw.get("kill_after_s") is not None:
+            raise RuntimeError("kill leg wedged")
+        return fake_round(hosts, **kw)
+
+    monkeypatch.setattr(bench, "_federation_round", kill_flaky)
+    out = bench.bench_multihost_scaling(host_counts=(1, 2))
+    assert out["host_kill"] == {"error": "RuntimeError: kill leg wedged"}
+    assert set(out["sigs_per_sec_by_hosts"]) == {"1", "2"}
 
 
 def test_slo_sweep_report_contract(monkeypatch):

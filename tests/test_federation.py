@@ -1,0 +1,386 @@
+"""Federated verify plane (crypto/federation.py): deterministic routing,
+hedged re-dispatch, per-host quarantine -> re-probe -> re-admit, the
+whole-tier degrade when every host is lost, the per-endpoint server-stats
+cache, and the federation-off bit-identity of the node's verifier
+selection. Everything here drives the router through its test seams
+(``pick_host`` directly; ``_channel_verify`` stubbed) — no sockets, no
+sidecar processes: the live wire path is tier-2 (bench multihost_scaling
++ the driver smoke)."""
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from corda_tpu.crypto.federation import (BULK_STICK_CAP_SIGS,
+                                         FederatedVerifier)
+from corda_tpu.crypto.provider import VerifyJob
+from corda_tpu.crypto.sidecar import LANE_CODE_BULK, LANE_CODE_INTERACTIVE
+from corda_tpu.node.verify_client import SidecarError
+
+
+def _fed(n_hosts=3, **kw):
+    kw.setdefault("device_min_sigs", 0)
+    return FederatedVerifier([f"/nonexistent/host{i}.sock"
+                              for i in range(n_hosts)], **kw)
+
+
+def _jobs(n=4):
+    # Garbage jobs: every tier (remote stub, local host oracle) rejects
+    # them identically, which is exactly what the fallback tests need.
+    return [VerifyJob(b"\x01" * 32, b"m%d" % i, b"\x02" * 64)
+            for i in range(n)]
+
+
+# -- routing policy ----------------------------------------------------------
+
+
+def test_interactive_routes_to_least_depth_with_index_tiebreak():
+    fed = _fed(3)
+    fed.channels[0].in_flight_sigs = 100
+    fed.channels[1].in_flight_sigs = 10
+    fed.channels[2].in_flight_sigs = 10
+    # Least depth wins; the 1-vs-2 tie breaks on the lower index.
+    assert fed.pick_host(8, LANE_CODE_INTERACTIVE) is fed.channels[1]
+    # Unlabelled traffic ranks exactly like interactive.
+    assert fed.pick_host(8, None) is fed.channels[1]
+    fed.channels[1].in_flight_sigs = 200
+    assert fed.pick_host(8, None) is fed.channels[2]
+
+
+def test_bulk_sticks_to_busiest_open_window_under_cap():
+    fed = _fed(3)
+    fed.channels[0].in_flight_sigs = 50
+    fed.channels[1].in_flight_sigs = 300   # busiest open window
+    fed.channels[2].in_flight_sigs = 0     # idle
+    # Bulk coalesce-sticks to the busiest window instead of opening a
+    # fresh one on the idle host (which interactive would pick).
+    assert fed.pick_host(8, LANE_CODE_BULK) is fed.channels[1]
+    assert fed.pick_host(8, LANE_CODE_INTERACTIVE) is fed.channels[2]
+    # Above the stick cap the window is full: bulk spreads like
+    # interactive again.
+    fed.channels[1].in_flight_sigs = BULK_STICK_CAP_SIGS
+    fed.channels[0].in_flight_sigs = BULK_STICK_CAP_SIGS
+    assert fed.pick_host(8, LANE_CODE_BULK) is fed.channels[2]
+
+
+def test_bulk_with_no_open_window_routes_least_depth():
+    fed = _fed(2)
+    assert fed.pick_host(8, LANE_CODE_BULK) is fed.channels[0]
+
+
+def test_unhealthy_hosts_are_skipped_and_none_when_all_down():
+    fed = _fed(2)
+    fed.channels[0].healthy.clear()
+    assert fed.pick_host(8, None) is fed.channels[1]
+    fed.channels[1].healthy.clear()
+    assert fed.pick_host(8, None) is None
+
+
+# -- hedged re-dispatch ------------------------------------------------------
+
+
+def test_hedge_fires_exactly_once_and_first_answer_wins(monkeypatch):
+    fed = _fed(3, hedge_ms=40.0, reprobe_cooldown_s=60.0)
+    jobs = _jobs(4)
+    calls = []
+    release = threading.Event()
+
+    def channel_verify(channel, jb, hint):
+        calls.append(channel.index)
+        if channel.index == 0:
+            # Slow primary: parks well past the hedge threshold.
+            release.wait(5.0)
+            return np.ones(len(jb), bool)
+        return np.zeros(len(jb), bool)
+
+    monkeypatch.setattr(fed, "_channel_verify", channel_verify)
+    out = fed._verify_ed25519_device(jobs)
+    release.set()
+    # The hedge (host 1: next-ranked healthy, never the primary) answered
+    # first and its verdicts won; exactly one hedge was dispatched.
+    assert not out.any()
+    assert calls == [0, 1]
+    assert fed.hedges == 1
+    assert fed.channels[0].hedges == 1  # counted against the slow primary
+    assert fed.channels[1].hedge_wins == 1
+    assert fed.channels[2].dispatches == 0
+    # A second, fast batch must not hedge at all.
+    calls.clear()
+    monkeypatch.setattr(fed, "_channel_verify",
+                        lambda c, jb, h: np.zeros(len(jb), bool))
+    fed._verify_ed25519_device(jobs)
+    assert fed.hedges == 1
+
+
+def test_slow_primary_verdict_discarded_not_double_applied(monkeypatch):
+    fed = _fed(2, hedge_ms=30.0, reprobe_cooldown_s=60.0)
+    jobs = _jobs(4)
+    primary_done = threading.Event()
+
+    def channel_verify(channel, jb, hint):
+        if channel.index == 0:
+            time.sleep(0.15)
+            primary_done.set()
+            return np.ones(len(jb), bool)  # the LOSING verdict
+        return np.zeros(len(jb), bool)
+
+    monkeypatch.setattr(fed, "_channel_verify", channel_verify)
+    out = fed._verify_ed25519_device(jobs)
+    assert not out.any()  # hedge won; the primary's late answer discarded
+    assert primary_done.wait(5.0)
+    # The loser resolved without corrupting the depth bookkeeping.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and fed.channels[0].in_flight_sigs:
+        time.sleep(0.01)
+    assert fed.channels[0].in_flight_sigs == 0
+    assert fed.channels[1].in_flight_sigs == 0
+
+
+# -- failure: quarantine, failover, re-admit ---------------------------------
+
+
+def test_host_failure_quarantines_and_batch_answers_locally(monkeypatch):
+    fed = _fed(2, hedge_ms=5000.0, reprobe_cooldown_s=60.0)
+    jobs = _jobs(4)
+
+    def channel_verify(channel, jb, hint):
+        if channel.index == 0:
+            raise SidecarError("host0 died")
+        return np.zeros(len(jb), bool)
+
+    monkeypatch.setattr(fed, "_channel_verify", channel_verify)
+    # First batch routes to host 0 (least depth, lowest index), which
+    # dies: the batch answers from the oracle-exact LOCAL host tier and
+    # host 0 is quarantined — the tier gate stays OPEN (host 1 lives).
+    out = fed.verify_batch(jobs)
+    assert not np.asarray(out, bool).any()
+    assert fed.fallbacks == 1
+    assert not fed.channels[0].healthy.is_set()
+    assert fed.channels[0].quarantines == 1
+    assert fed.host_degraded == 1
+    assert fed.device_gate is None or fed.device_gate.is_set()
+    # The NEXT batch routes around the quarantined host: remote answer.
+    out2 = fed.verify_batch(jobs)
+    assert not np.asarray(out2, bool).any()
+    assert fed.channels[1].dispatches == 1
+    assert fed.device_batches == 1
+
+
+def test_quarantined_host_reprobes_and_readmits(monkeypatch):
+    fed = _fed(2, reprobe_cooldown_s=0.05)
+    warm_calls = []
+
+    def warm_flaky():
+        warm_calls.append(1)
+        if len(warm_calls) < 3:
+            raise SidecarError("still down")
+
+    monkeypatch.setattr(fed.channels[0].client, "warm", warm_flaky)
+    fed._quarantine(fed.channels[0], SidecarError("boom"))
+    assert not fed.channels[0].healthy.is_set()
+    deadline = time.monotonic() + 10.0
+    while (time.monotonic() < deadline
+           and not fed.channels[0].healthy.is_set()):
+        time.sleep(0.01)
+    # The cooldown ping re-probe kept trying and re-admitted the host.
+    assert fed.channels[0].healthy.is_set()
+    assert fed.channels[0].readmits == 1
+    assert len(warm_calls) >= 3
+    # Routing sees it again immediately.
+    assert fed.pick_host(8, None) is fed.channels[0]
+
+
+def test_quarantine_idempotent_while_reprobe_pending(monkeypatch):
+    fed = _fed(2, reprobe_cooldown_s=60.0)
+    monkeypatch.setattr(
+        fed.channels[0].client, "warm",
+        lambda: (_ for _ in ()).throw(SidecarError("down")))
+    fed._quarantine(fed.channels[0], SidecarError("first"))
+    fed._quarantine(fed.channels[0], SidecarError("second"))
+    assert fed.channels[0].quarantines == 1  # one quarantine event
+    assert fed.channels[0].failures == 2     # ... from two failures
+    assert fed.host_degraded == 1
+
+
+def test_all_hosts_lost_degrades_whole_tier_exact_answer(monkeypatch):
+    fed = _fed(2, hedge_ms=5.0, reprobe_cooldown_s=60.0)
+    jobs = _jobs(4)
+    monkeypatch.setattr(
+        fed, "_channel_verify",
+        lambda c, jb, h: (_ for _ in ()).throw(SidecarError("dead")))
+    for ch in fed.channels:
+        monkeypatch.setattr(
+            ch.client, "warm",
+            lambda: (_ for _ in ()).throw(SidecarError("dead")))
+    # A fast-failing primary resolves BEFORE the hedge clock: each batch
+    # quarantines one host and answers locally; the gate stays open
+    # while any host lives.
+    out = fed.verify_batch(jobs)
+    assert not np.asarray(out, bool).any()
+    assert fed.fallbacks == 1
+    assert not fed.channels[0].healthy.is_set()
+    assert fed.channels[1].healthy.is_set()
+    assert fed.device_gate is None or fed.device_gate.is_set()
+    # The second batch kills the survivor: no host left — the WHOLE tier
+    # degrades, and the answer is still exact.
+    out = fed.verify_batch(jobs)
+    assert not np.asarray(out, bool).any()
+    assert fed.fallbacks == 2
+    assert all(not c.healthy.is_set() for c in fed.channels)
+    assert fed.device_gate is not None and not fed.device_gate.is_set()
+    assert fed.degraded == 1
+    # While degraded, batches route straight to the local host tier.
+    fed.verify_batch(jobs)
+    assert fed.host_batches == 3
+
+
+def test_device_method_raises_when_no_host_healthy():
+    fed = _fed(2)
+    for c in fed.channels:
+        c.healthy.clear()
+    with pytest.raises(SidecarError):
+        fed._verify_ed25519_device(_jobs(2))
+
+
+# -- stamps ------------------------------------------------------------------
+
+
+def test_federation_stats_shares_and_decision_ring(monkeypatch):
+    fed = _fed(2, reprobe_cooldown_s=60.0)
+    monkeypatch.setattr(fed.channels[0].client, "_server_stats_maybe",
+                        lambda: {"stub": 0})
+    monkeypatch.setattr(fed.channels[1].client, "_server_stats_maybe",
+                        lambda: {"stub": 1})
+    monkeypatch.setattr(fed, "_channel_verify",
+                        lambda c, jb, h: np.zeros(len(jb), bool))
+    for _ in range(4):
+        fed._verify_ed25519_device(_jobs(4))
+    fs = fed.federation_stats()
+    assert fs["n_hosts"] == 2 and fs["healthy_hosts"] == 2
+    assert fs["dispatches"] == 4
+    # Serial batches always see zero depth: all land on host 0.
+    assert fs["routing_share_by_host"][fed.channels[0].address] == 1.0
+    assert fs["routing_share_by_host"][fed.channels[1].address] == 0.0
+    assert len(fs["recent_decisions"]) == 4
+    d = fs["recent_decisions"][-1]
+    assert d["host"] == fed.channels[0].address and d["hedged"] is False
+    assert set(d["depths"]) == {c.address for c in fed.channels}
+    # The node_metrics seam: same duck type the single sidecar stamps.
+    sc = fed.sidecar_stats()
+    assert sc["address"] == ",".join(c.address for c in fed.channels)
+    assert sc["federation"]["dispatches"] == 4
+    assert sc["batches"] == 0  # client-side wire counters never ran
+
+
+def test_qos_hint_hands_off_to_winning_channel(monkeypatch):
+    # The real _channel_verify runs here (only the channel CLIENT's wire
+    # method is stubbed): the advisory hint must reach the chosen host's
+    # client so the remote deadline scheduler can order around it.
+    fed = _fed(2)
+    seen = {}
+
+    def client_verify(jb):
+        seen["hint"] = fed.channels[0].client.qos_hint
+        return np.zeros(len(jb), bool)
+
+    monkeypatch.setattr(fed.channels[0].client, "_verify_ed25519_device",
+                        client_verify)
+    fed.qos_hint = (LANE_CODE_BULK, 123456789)
+    fed._verify_ed25519_device(_jobs(2))
+    assert seen["hint"] == (LANE_CODE_BULK, 123456789)
+
+
+# -- satellite: the per-endpoint server-stats cache --------------------------
+
+
+def test_server_stats_cache_is_per_endpoint(monkeypatch):
+    from corda_tpu.node import verify_client
+    from corda_tpu.node.verify_client import SidecarVerifier
+
+    client = SidecarVerifier("ep-a")
+    fetched = []
+
+    def fake_fetch(address, timeout=2.0):
+        fetched.append(address)
+        return {"endpoint": address}
+
+    monkeypatch.setattr(verify_client, "fetch_sidecar_stats", fake_fetch)
+    assert client._server_stats_maybe() == {"endpoint": "ep-a"}
+    # Within the 5s window the cached snapshot serves — no second fetch.
+    assert client._server_stats_maybe() == {"endpoint": "ep-a"}
+    assert fetched == ["ep-a"]
+    # The latent single-slot bug: after an address change, the old cache
+    # entry must NEVER masquerade as the new endpoint's snapshot.
+    client.address = "ep-b"
+    assert client._server_stats_maybe() == {"endpoint": "ep-b"}
+    assert fetched == ["ep-a", "ep-b"]
+    # ... and flipping back within the window hits ep-a's own entry.
+    client.address = "ep-a"
+    assert client._server_stats_maybe() == {"endpoint": "ep-a"}
+    assert fetched == ["ep-a", "ep-b"]
+
+
+# -- the node's verifier selection (federation-off bit-identity) -------------
+
+
+def _cfg(tmp_path, **batch_kw):
+    from corda_tpu.node.config import BatchConfig, NodeConfig
+
+    return NodeConfig(name="n", base_dir=tmp_path,
+                      batch=BatchConfig(**batch_kw))
+
+
+def test_select_verifier_federation_off_is_bit_identical(tmp_path,
+                                                         monkeypatch):
+    from corda_tpu.node.node import _make_verifier, _select_batch_verifier
+    from corda_tpu.node.verify_client import SidecarVerifier
+
+    monkeypatch.delenv("CORDA_TPU_FEDERATION", raising=False)
+    monkeypatch.delenv("CORDA_TPU_SIDECAR", raising=False)
+    # No federation, no sidecar: exactly the local provider the
+    # pre-federation tree selected.
+    v = _select_batch_verifier(_cfg(tmp_path))
+    assert type(v) is type(_make_verifier("cpu"))
+    # Single sidecar: exactly the single-host client, NOT a one-host
+    # federation — the single-sidecar wire path stays bit-identical.
+    v = _select_batch_verifier(_cfg(tmp_path, sidecar="/tmp/sc.sock",
+                                    sidecar_deadline_ms=1234.0))
+    assert type(v) is SidecarVerifier
+    assert v.address == "/tmp/sc.sock"
+    assert v.deadline_s == pytest.approx(1.234)
+
+
+def test_select_verifier_federation_config_and_env(tmp_path, monkeypatch):
+    from corda_tpu.node.node import _select_batch_verifier
+
+    monkeypatch.delenv("CORDA_TPU_FEDERATION", raising=False)
+    v = _select_batch_verifier(_cfg(
+        tmp_path, federation_hosts="hostA.sock, hostB.sock",
+        sidecar="/ignored.sock", sidecar_deadline_ms=500.0))
+    assert isinstance(v, FederatedVerifier)
+    # federation_hosts takes precedence over sidecar; whitespace-tolerant.
+    assert [c.address for c in v.channels] == ["hostA.sock", "hostB.sock"]
+    assert v.deadline_s == pytest.approx(0.5)
+    # The env var the driver plants works like the config key.
+    monkeypatch.setenv("CORDA_TPU_FEDERATION", "h0.sock,h1.sock,h2.sock")
+    v = _select_batch_verifier(_cfg(tmp_path))
+    assert isinstance(v, FederatedVerifier)
+    assert len(v.channels) == 3
+
+
+def test_batch_config_parses_federation_hosts_list_and_string(tmp_path):
+    from corda_tpu.node.config import NodeConfig
+
+    raw = {"name": "n", "base_dir": str(tmp_path),
+           "batch": {"federation_hosts": ["a.sock", "b.sock"]}}
+    cfg = NodeConfig.from_dict(raw)
+    assert cfg.batch.federation_hosts == "a.sock,b.sock"
+    raw["batch"] = {"federation_hosts": "a.sock,b.sock"}
+    assert NodeConfig.from_dict(raw).batch.federation_hosts == \
+        "a.sock,b.sock"
+    assert NodeConfig.from_dict(
+        {"name": "n", "base_dir": str(tmp_path)}).batch.federation_hosts \
+        == ""

@@ -242,6 +242,69 @@ def test_pipelined_dominant_apply_phase_maps_to_executor_rule():
         == doctor.RULES["apply"]
 
 
+def _fed_stamp(shares, occs=None, dispatches_total=100):
+    """A member stamp whose sidecar block carries a federation routing
+    view (FederatedVerifier.federation_stats shape, trimmed)."""
+    hosts = {}
+    for i, (addr, share) in enumerate(sorted(shares.items())):
+        hosts[addr] = {"dispatches": int(share * dispatches_total),
+                       "server": ({"device_batches": None,
+                                   "device_occupancy": (occs or {}).get(addr)}
+                                  if occs else None)}
+    return {"sidecar": {"federation": {
+        "hosts": hosts, "hedges": 7, "host_degraded": 0}}}
+
+
+def test_host_imbalance_rule_fires_on_routing_share_skew():
+    stamps = {"Notary": _fed_stamp(
+        {"h0.sock": 0.8, "h1.sock": 0.2},
+        occs={"h0.sock": 0.9, "h1.sock": 0.2})}
+    verdict = doctor.stamp_attribution(stamps)
+    assert verdict["first_bottleneck"] == "host_imbalance"
+    top = verdict["bottlenecks"][0]
+    # Skew 0.6 -> score 0.8; the experiment names the two levers.
+    assert top["score"] == 0.8
+    assert "rebalance" in top["next_experiment"]
+    assert "hedge" in top["next_experiment"]
+    # Evidence pairs each host's routed share with its own occupancy.
+    assert top["evidence"]["routing_share_by_host"] == {
+        "h0.sock": 0.8, "h1.sock": 0.2}
+    assert top["evidence"]["occupancy_by_host"] == {
+        "h0.sock": 0.9, "h1.sock": 0.2}
+    assert top["evidence"]["hedges"] == 7
+
+
+def test_host_imbalance_abstains_on_balanced_routing():
+    stamps = {"Notary": _fed_stamp({"h0.sock": 0.55, "h1.sock": 0.45})}
+    verdict = doctor.stamp_attribution(stamps)
+    # Skew 0.1 < threshold: the router's depth balancing is working.
+    assert all(b["cause"] != "host_imbalance"
+               for b in verdict["bottlenecks"])
+    # Single-host "federations" and sidecar-less members never fire it.
+    assert doctor.stamp_attribution(
+        {"A": _fed_stamp({"h0.sock": 1.0})})["first_bottleneck"] is None
+    assert doctor.stamp_attribution(
+        {"A": {"sidecar": None}})["first_bottleneck"] is None
+
+
+def test_host_imbalance_merges_dispatches_across_members():
+    # Two members each skewed toward a DIFFERENT host: the cluster-wide
+    # routing is balanced, so the merged verdict must abstain — a
+    # per-member diagnosis would fire twice and be wrong both times.
+    stamps = {"A": _fed_stamp({"h0.sock": 0.8, "h1.sock": 0.2}),
+              "B": _fed_stamp({"h0.sock": 0.2, "h1.sock": 0.8})}
+    verdict = doctor.stamp_attribution(stamps)
+    assert all(b["cause"] != "host_imbalance"
+               for b in verdict["bottlenecks"])
+    # Both skewed the SAME way sums to a cluster-wide imbalance.
+    stamps = {"A": _fed_stamp({"h0.sock": 0.8, "h1.sock": 0.2}),
+              "B": _fed_stamp({"h0.sock": 0.7, "h1.sock": 0.3})}
+    verdict = doctor.stamp_attribution(stamps)
+    assert verdict["first_bottleneck"] == "host_imbalance"
+    assert verdict["bottlenecks"][0]["evidence"][
+        "routing_share_by_host"] == {"h0.sock": 0.75, "h1.sock": 0.25}
+
+
 def test_stamp_attribution_empty_and_scalar_polluted_stamps():
     assert doctor.stamp_attribution({})["first_bottleneck"] is None
     assert doctor.stamp_attribution(None)["first_bottleneck"] is None
