@@ -1692,6 +1692,79 @@ def bench_durability(n_tx=60, cluster_size=3, rate_tx_s=120.0,
     return out
 
 
+def bench_partition_chaos(n_tx=36, cluster_size=3, cut_hold_s=4.0):
+    """Partition section (round 20): deterministic split-brain over the
+    in-process TCP cluster, audited by the history checker
+    (testing/history.py). Three error-isolated legs:
+
+    * split_leader — leader isolated, prevote ON: check-quorum must cede
+      the quorumless leadership, the majority keeps committing, and the
+      heal-to-first-commit recovery is measured (recovery_s).
+    * split_follower_prevote / split_follower_noprevote — a follower
+      isolated, prevote ON vs OFF: the A/B for term inflation. With
+      pre-vote the cut-off member canvasses without persisting a term
+      (bounded inflation); without it every futile timeout inflates the
+      term and the rejoiner disrupts the healthy side at heal.
+
+    Headline keys hoisted flat for the bench contract: recovery_s,
+    max_term_inflation (prevote on) vs max_term_inflation_noprevote,
+    history_linearizable (AND over every leg), minority_commits,
+    lost_acks, partition_cuts, checkquorum_stepdowns."""
+    out = {"plan": "split-hold", "n_tx": n_tx}
+    legs = (
+        ("split_leader", "leader", True),
+        ("split_follower_prevote", "follower", True),
+        ("split_follower_noprevote", "follower", False),
+    )
+    linearizable = True
+    for key, isolate, prevote in legs:
+        try:
+            from corda_tpu.tools.loadtest import run_partition_loadtest
+
+            r = run_partition_loadtest(
+                n_tx=n_tx, cluster_size=cluster_size, prevote=prevote,
+                isolate=isolate, cut_hold_s=cut_hold_s)
+            out[key] = {
+                "prevote": r.prevote,
+                "isolate": r.isolate,
+                "tx_committed": r.tx_committed,
+                "tx_unresolved": r.tx_unresolved,
+                "recovery_s": r.recovery_s,
+                "max_term_inflation": r.max_term_inflation,
+                "minority_commits_during_cut": r.minority_commits_during_cut,
+                "checkquorum_stepdowns": r.checkquorum_stepdowns,
+                "prevotes": r.prevotes,
+                "prevote_rejections": r.prevote_rejections,
+                "partition_cuts": r.partition_cuts,
+                "partition_drops": r.partition_drops,
+                "history_linearizable": r.history_linearizable,
+                "lost_acks": r.lost_acks,
+                "double_spends": r.double_spends,
+            }
+            linearizable = linearizable and r.history_linearizable
+        except BenchTimeout:
+            raise
+        except Exception as e:
+            out[key] = {"error": f"{type(e).__name__}: {e}"}
+            linearizable = False
+    lead = out.get("split_leader", {})
+    on = out.get("split_follower_prevote", {})
+    off = out.get("split_follower_noprevote", {})
+    out["recovery_s"] = lead.get("recovery_s")
+    out["checkquorum_stepdowns"] = lead.get("checkquorum_stepdowns")
+    out["max_term_inflation"] = on.get("max_term_inflation")
+    out["max_term_inflation_noprevote"] = off.get("max_term_inflation")
+    out["history_linearizable"] = linearizable
+    out["minority_commits"] = sum(
+        leg.get("minority_commits_during_cut", 0) for leg in
+        (lead, on, off))
+    out["lost_acks"] = sum(
+        leg.get("lost_acks", 0) for leg in (lead, on, off))
+    out["partition_cuts"] = sum(
+        leg.get("partition_cuts", 0) for leg in (lead, on, off))
+    return out
+
+
 class BenchTimeout(Exception):
     pass
 
@@ -2014,6 +2087,13 @@ def _run_host_only_phases(report: dict,
         raise
     except Exception as e:
         report["durability"] = {"error": f"{type(e).__name__}: {e}"}
+    set_phase("partition_chaos")
+    try:
+        report["partition_chaos"] = bench_partition_chaos()
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["partition_chaos"] = {"error": f"{type(e).__name__}: {e}"}
     set_phase("cpu_oracle")
     pks, msgs, sigs, _ = make_corpus()
     report["cpu_oracle_sigs_per_sec"] = round(
@@ -2253,6 +2333,13 @@ def _run_phases(report: dict) -> None:
         raise
     except Exception as e:
         report["durability"] = {"error": f"{type(e).__name__}: {e}"}
+    set_phase("partition_chaos")
+    try:
+        report["partition_chaos"] = bench_partition_chaos()
+    except BenchTimeout:
+        raise
+    except Exception as e:
+        report["partition_chaos"] = {"error": f"{type(e).__name__}: {e}"}
     # The doctor diagnoses the finished report — last, so its roofline
     # sees every section (kernel ceiling, flagship, chaos) this run
     # produced.

@@ -107,6 +107,14 @@ class RaftConfig:
     # of per-ref statements. Byte-identical rows; False falls back to the
     # per-command apply.
     commit_many: bool = True
+    # Partition hardening (round 20): pre-vote canvass before any real
+    # election (a candidate probes at term+1 WITHOUT incrementing its
+    # persisted term, so a partitioned rejoiner cannot depose a healthy
+    # leader) plus check-quorum leader step-down (a leader that hears no
+    # quorum for a full election window stops answering as leader).
+    # False (the default) leaves election behaviour bit-identical to the
+    # pre-partition-plane tree.
+    prevote: bool = False
 
 
 @dataclass(frozen=True)
@@ -296,6 +304,7 @@ class NodeConfig:
                 pipeline=bool(raft.get("pipeline", True)),
                 apply_queue_depth=int(raft.get("apply_queue_depth", 4096)),
                 commit_many=bool(raft.get("commit_many", True)),
+                prevote=bool(raft.get("prevote", False)),
             ),
             qos=QosConfig(
                 enabled=bool(qos.get("enabled", False)),

@@ -123,6 +123,11 @@ class InMemoryMessagingNetwork:
             obs(record)
         duplicate = False
         if _faults.ACTIVE is not None:
+            # Partition cut, send side: the frame never enters the medium
+            # (the observer record above stays — the cut is the network
+            # eating the frame, not the sender not offering it).
+            if _faults.fire_partition(sender, recipient):
+                return
             act = _faults.ACTIVE.fire("transport.send")
             if act is not None:
                 action, delay_s = act
@@ -156,6 +161,12 @@ class InMemoryMessagingNetwork:
                 self._durable.setdefault(recipient, deque()).append(message)
                 continue
             if _faults.ACTIVE is not None:
+                # Partition cut, recv side: catches frames already in
+                # flight when the cut armed (send-side alone would let
+                # them slip through and blur the cut edge).
+                if message.sender is not None and _faults.fire_partition(
+                        message.sender, recipient):
+                    continue
                 act = _faults.ACTIVE.fire("transport.recv")
                 if act is not None:
                     action, delay_s = act

@@ -466,8 +466,14 @@ class TcpMessaging(MessagingService):
         frame = serialize(self._wire_tuple(topic_session, unique_id, data)).bytes
         peer = str(to)
         self._outbox.append(peer, unique_id, frame)
-        if _faults.ACTIVE is not None and self._fault_send(peer, unique_id, frame):
-            return
+        if _faults.ACTIVE is not None:
+            # Partition cut, send side: the durable row stays (heal means
+            # redeliver, same as wire loss) but the bridge is not woken —
+            # the bridge loop itself parks while the cut covers this peer.
+            if _faults.fire_partition(self.my_address, peer):
+                return
+            if self._fault_send(peer, unique_id, frame):
+                return
         if self._db is not None and self._db.in_batch:
             # The row isn't committed yet; bridges read via the aux
             # connection and would see nothing. Wake them after the round.
@@ -511,8 +517,11 @@ class TcpMessaging(MessagingService):
                 self._wire_tuple(topic_session, unique_id, data)).bytes))
         peer = str(to)
         self._outbox.append_many(peer, entries)
-        if _faults.ACTIVE is not None and self._fault_send(peer, None, None):
-            return  # whole burst "lost"; the fallback re-poll redelivers
+        if _faults.ACTIVE is not None:
+            if _faults.fire_partition(self.my_address, peer):
+                return  # cut: rows stay, bridge stays parked until heal
+            if self._fault_send(peer, None, None):
+                return  # whole burst "lost"; the fallback re-poll redelivers
         if self._db is not None and self._db.in_batch:
             self._deferred_bridge_peers.add(peer)
         else:
@@ -593,6 +602,15 @@ class TcpMessaging(MessagingService):
         host, port_s = peer.rsplit(":", 1)
         attempt = 0
         while self._running:
+            # Park across a held partition cut instead of churning the
+            # connect/replay/stale-resend cycle (each cycle resends the
+            # whole un-ACKed outbox into a void that never ACKs). A pure
+            # QUERY — polling here must not advance the cut schedule.
+            if _faults.ACTIVE is not None and _faults.partitioned(
+                    self._address, peer):
+                wakeup.clear()
+                wakeup.wait(timeout=0.25)
+                continue
             try:
                 pending = self._outbox.pending(peer)
             except sqlite3.ProgrammingError:
@@ -661,6 +679,14 @@ class TcpMessaging(MessagingService):
             now = time.monotonic()
             if sent and now - last_stale_check > 1.0:
                 last_stale_check = now
+                # A cut that armed while this connection was warm: exit to
+                # the bridge loop's partition park NOW (plain OSError, not
+                # a stale resend — the cut is known, not suspected; without
+                # this the loop would burn a full STALE_RESEND_S window
+                # resending the outbox into the void once per window).
+                if _faults.ACTIVE is not None and _faults.partitioned(
+                        self._address, peer):
+                    raise OSError("partition cut covers peer")
                 if now - min(sent.values()) > self.STALE_RESEND_S:
                     self._note_stale_resend()
                     raise OSError("frames un-ACKed past stale-resend window")
@@ -908,6 +934,13 @@ class TcpMessaging(MessagingService):
 
     def _dispatch(self, conn, message: Message) -> bool:
         if _faults.ACTIVE is not None:
+            # Partition cut, recv side — the authoritative enforcement (a
+            # frame that slipped out before the cut armed still dies
+            # here). No ack, no dedupe record: after heal the sender's
+            # durable outbox redelivers, preserving at-least-once.
+            if message.sender is not None and _faults.fire_partition(
+                    message.sender, self._address):
+                return False
             act = _faults.ACTIVE.fire("transport.recv")
             if act is not None:
                 action, delay_s = act

@@ -243,6 +243,38 @@ class VoteReply:
 
 @register
 @dataclass(frozen=True)
+class PreVote:
+    """Pre-vote canvass (partition plane, round 20; Raft §9.6 / Ongaro's
+    thesis §9.6): a would-be candidate asks "would you vote for me at
+    ``term``?" WITHOUT incrementing or persisting anything on either side.
+    ``term`` is the term the canvasser WOULD campaign at (current + 1).
+    A rejoining minority member therefore cannot inflate the cluster term
+    and depose a healthy leader just by having sat behind a cut: it first
+    has to win a canvass, which a quorum with a live leader refuses. Only
+    sent when ``[raft] prevote = true`` — a cluster with the flag off
+    never puts this frame on the wire."""
+
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@register
+@dataclass(frozen=True)
+class PreVoteReply:
+    """Canvass answer. ``term`` is the REPLIER's current term (so a
+    canvasser behind on terms catches up without a disruptive election);
+    ``granted`` means "your log is current AND I have not heard from a
+    live leader within the minimum election window". Never persisted."""
+
+    term: int
+    granted: bool
+    voter: str
+
+
+@register
+@dataclass(frozen=True)
 class AppendEntries:
     term: int
     leader: str
@@ -482,6 +514,22 @@ class RaftMember:
         self._last_heartbeat = self.clock()
         self._snapshot_sent_at: dict[str, float] = {}
         self._election_deadline = self._next_election_deadline()
+        # Partition hardening (round 20, [raft] prevote): pre-vote canvass
+        # state (who granted the current canvass; advisory only — a real
+        # election still collects real votes), last contact from a live
+        # leader (follower side: the §9.6 leader-stickiness check), last
+        # reply from each peer (leader side: check-quorum). The contact
+        # stamps are written unconditionally — plain attribute writes with
+        # no observable effect — but every BEHAVIOR (canvassing, granting
+        # semantics, step-down) is gated on config.prevote so the flag-off
+        # path is bit-identical to pre-round-20.
+        self._prevote_votes: set[str] = set()
+        self._prevoting = False
+        self._last_leader_contact = self.clock()
+        self._peer_contact: dict[str, float] = {}
+        # Candidacy start (self.clock() timeline) for the `election` marker
+        # span recorded at the win; spans canvass start when prevote is on.
+        self._candidacy_t0: float | None = None
         # request_id -> ClientReply for commits decided at this member.
         # Bounded: late/duplicate replies for abandoned requests must not
         # accumulate on a long-running cluster.
@@ -558,6 +606,18 @@ class RaftMember:
             "log_truncations": 0,   # corrupt-suffix heals (truncate/compact)
             "leader_stepdowns": 0,  # leaderships ceded to corruption/disk
             "disk_degraded": 0,     # disk-full write failures absorbed
+            # Partition plane (round 20): pre-vote canvasses started here,
+            # canvass grants withheld here (live leader / stale log), and
+            # leaderships ceded because a quorum of peers went silent
+            # (check-quorum; these ALSO count in leader_stepdowns). All 0
+            # with [raft] prevote off. elections_won counts every
+            # leadership this member assumed — with prevote on, term and
+            # elections_won stay bounded across a partition/heal cycle;
+            # with it off, term inflates once per futile minority timeout.
+            "prevotes": 0,
+            "prevote_rejections": 0,
+            "checkquorum_stepdowns": 0,
+            "elections_won": 0,
         }
         # Leader seal-path phase accumulators (seconds), read as per-round
         # deltas by node.run_once to split its raft segment into the
@@ -809,14 +869,24 @@ class RaftMember:
             if self._applied_enqueued < self.commit_index:
                 self._enqueue_committed()
         if self.role == "leader":
-            if (self._append_dirty
+            if self.config.prevote and not self._quorum_alive(now):
+                # Check-quorum: a leader that cannot hear a quorum (e.g. it
+                # landed on the minority side of a cut) steps down instead
+                # of silently accepting submissions it can never commit —
+                # clients get bounced to re-route promptly rather than
+                # timing out against a zombie leader.
+                self._checkquorum_stepdown()
+            elif (self._append_dirty
                     or now - self._last_heartbeat
                     >= self.HEARTBEAT * self.scale):
                 self.flush_appends()
         else:
             self._flush_forwards()
             if now >= self._election_deadline:
-                self._start_election()
+                if self.config.prevote:
+                    self._start_prevote()
+                else:
+                    self._start_election()
 
     def flush_appends(self) -> None:
         """The commit pipeline's per-round flush: seal the round's buffered
@@ -933,6 +1003,11 @@ class RaftMember:
             self._save_meta()
         was_leader = self.role == "leader"
         self.role = "follower"
+        # Any follower transition invalidates an in-flight canvass (a live
+        # leader or higher term appeared) and the candidacy span anchor;
+        # harmless no-ops when prevote off.
+        self._prevoting = False
+        self._candidacy_t0 = None
         if leader is not None:
             self.leader_name = leader
             self._election_attempts = 0  # a live leader resets the backoff
@@ -969,6 +1044,10 @@ class RaftMember:
     def _start_election(self) -> None:
         if self.role == "candidate":
             self._election_attempts += 1  # previous election went nowhere
+        if self._candidacy_t0 is None:
+            # Canvass-initiated elections already stamped candidacy start;
+            # a direct (prevote-off) election starts its span here.
+            self._candidacy_t0 = self.clock()
         self.term += 1
         self.voted_for = self.name
         self._save_meta()
@@ -989,6 +1068,24 @@ class RaftMember:
             self.role = "leader"
             self.leader_name = self.name
             self._election_attempts = 0
+            self.metrics["elections_won"] += 1
+            now = self.clock()
+            if self.config.prevote:
+                # Check-quorum baseline: every peer counts as heard-from at
+                # the moment of the win, so a fresh leadership gets a full
+                # window to establish contact before step-down can trigger.
+                self._peer_contact = {p: now for p in self.peers}
+            if _obs.ACTIVE is not None and self._candidacy_t0 is not None:
+                # Re-anchor the candidacy (monotonic clock) onto the epoch
+                # timeline ending now — same convention as the replication
+                # span in _advance_commit.
+                epoch = _obs.now()
+                _obs.record(
+                    "election",
+                    epoch - (now - self._candidacy_t0), epoch,
+                    attrs={"term": self.term,
+                           "prevote": bool(self.config.prevote)})
+            self._candidacy_t0 = None
             last_idx, _ = self._log_last()
             self._next_index = {p: last_idx + 1 for p in self.peers}
             self._match_index = {p: 0 for p in self.peers}
@@ -1124,6 +1221,10 @@ class RaftMember:
             self._on_request_vote(payload, message.sender)
         elif isinstance(payload, VoteReply):
             self._on_vote_reply(payload)
+        elif isinstance(payload, PreVote):
+            self._on_prevote(payload, message.sender)
+        elif isinstance(payload, PreVoteReply):
+            self._on_prevote_reply(payload)
         elif isinstance(payload, AppendEntries):
             self._on_append(payload, message.sender)
         elif isinstance(payload, AppendReply):
@@ -1155,6 +1256,7 @@ class RaftMember:
             if payload.term > self.term:
                 self._become_follower(payload.term)
             elif self.role == "leader":
+                self._peer_contact[payload.follower] = self.clock()
                 match = max(self._match_index.get(payload.follower, 0),
                             payload.last_included_index)
                 self._match_index[payload.follower] = match
@@ -1206,6 +1308,93 @@ class RaftMember:
         if self.role == "candidate" and vr.term == self.term and vr.granted:
             self._votes.add(vr.voter)
             self._maybe_win()
+
+    # -- pre-vote / check-quorum (partition plane, round 20) ---------------
+
+    def _start_prevote(self) -> None:
+        """Canvass at term+1 WITHOUT touching persisted state: role stays
+        follower, term/voted_for untouched, nothing fsynced. Only a
+        majority of would-grant replies converts into a real election —
+        so a member that spent the cut on the minority side times out
+        forever without inflating the cluster term, and rejoins at heal
+        as a follower instead of deposing the healthy leader."""
+        self._prevoting = True
+        self._prevote_votes = {self.name}
+        self._candidacy_t0 = self.clock()
+        self.metrics["prevotes"] += 1
+        if _tm.ACTIVE is not None:
+            _tm.inc("raft_prevotes_total")
+        self._election_deadline = self._next_election_deadline()
+        last_idx, last_term = self._log_last()
+        msg = PreVote(self.term + 1, self.name, last_idx, last_term)
+        for peer in self.peers.values():
+            self._send(peer, msg)
+        self._maybe_canvass_win()
+
+    def _maybe_canvass_win(self) -> None:
+        if not self._prevoting:
+            return
+        if len(self._prevote_votes) * 2 > len(self.peers) + 1:
+            self._prevoting = False
+            self._start_election()
+
+    def _on_prevote(self, pv: PreVote, sender) -> None:
+        """Answer a canvass. NEVER mutates term/voted_for/role — granting
+        here is a promise-free opinion ("I would vote for you"), so
+        concurrent canvassers are harmless. Withheld when this member is
+        the leader or heard from one within the MINIMUM election window
+        (§9.6 leader stickiness: a live leader's cluster refuses to be
+        disrupted), or when the canvasser's log is behind."""
+        granted = False
+        if pv.term >= self.term:
+            last_idx, last_term = self._log_last()
+            up_to_date = (pv.last_log_term, pv.last_log_index) >= (
+                last_term, last_idx)
+            lo, _hi = self.ELECTION_TIMEOUT
+            leader_live = (
+                self.role == "leader"
+                or (self.leader_name is not None
+                    and self.clock() - self._last_leader_contact
+                    < lo * self.scale))
+            granted = up_to_date and not leader_live
+        if not granted:
+            self.metrics["prevote_rejections"] += 1
+            if _tm.ACTIVE is not None:
+                _tm.inc("raft_prevote_rejections_total")
+        self._send(sender, PreVoteReply(self.term, granted, self.name))
+
+    def _on_prevote_reply(self, pvr: PreVoteReply) -> None:
+        if pvr.term > self.term:
+            # A peer is ahead: adopt its term quietly (no election) — the
+            # exact rejoin path the canvass exists for.
+            self._become_follower(pvr.term)
+            return
+        if self._prevoting and pvr.granted:
+            self._prevote_votes.add(pvr.voter)
+            self._maybe_canvass_win()
+
+    def _quorum_alive(self, now: float) -> bool:
+        """Leader-side check-quorum: does a majority (self included) have
+        a reply newer than the check window? The window is twice the max
+        election timeout — wide enough that one slow pump cycle cannot
+        fake a partition, narrow enough that a minority-side leader cedes
+        within a couple of election windows of the cut."""
+        _lo, hi = self.ELECTION_TIMEOUT
+        window = 2 * hi * self.scale
+        alive = 1 + sum(
+            1 for p in self.peers
+            if now - self._peer_contact.get(p, 0.0) <= window)
+        return alive * 2 > len(self.peers) + 1
+
+    def _checkquorum_stepdown(self) -> None:
+        self.metrics["leader_stepdowns"] += 1
+        self.metrics["checkquorum_stepdowns"] += 1
+        if _tm.ACTIVE is not None:
+            _tm.inc("raft_checkquorum_stepdowns_total")
+        # No known successor: clients bounce with leader hint None and
+        # re-derive the leader after the (majority-side) election.
+        self.leader_name = None
+        self._become_follower(self.term)
 
     COMPACT_THRESHOLD = 256  # log entries kept before compacting applied ones
     SNAPSHOT_CHUNK = 10_000  # map entries per InstallSnapshot frame
@@ -1448,6 +1637,9 @@ class RaftMember:
             self._send(sender, AppendReply(self.term, False, 0, self.name))
             return
         self._become_follower(ae.term, leader=ae.leader)
+        # Leader-stickiness stamp (round 20): any valid append — heartbeat
+        # or entries — counts as live-leader contact for _on_prevote.
+        self._last_leader_contact = self.clock()
         local_prev = self._log_term_at(ae.prev_index)
         if local_prev is None or local_prev != ae.prev_term:
             self._send(sender, AppendReply(
@@ -1494,6 +1686,9 @@ class RaftMember:
             return
         if self.role != "leader":
             return
+        # Check-quorum stamp (round 20): ANY append reply — success or
+        # divergence backoff — proves the peer is reachable.
+        self._peer_contact[ar.follower] = self.clock()
         if ar.success:
             # Monotone: a success for an EARLIER position (e.g. the prev=0
             # keepalive heartbeat used during snapshot transfer) must not
@@ -1871,6 +2066,15 @@ class RaftMember:
             "log_truncations": m["log_truncations"],
             "leader_stepdowns": m["leader_stepdowns"],
             "disk_degraded": m["disk_degraded"],
+            # Partition plane (round 20): prevote canvass traffic and
+            # check-quorum cessions (0 with the flag off); elections_won +
+            # term are the A/B observables the partition_chaos bench reads
+            # for term inflation across a cut/heal cycle.
+            "prevote": bool(self.config.prevote),
+            "prevotes": m["prevotes"],
+            "prevote_rejections": m["prevote_rejections"],
+            "checkquorum_stepdowns": m["checkquorum_stepdowns"],
+            "elections_won": m["elections_won"],
             "replication_rtt_ms_avg": (
                 round(1e3 * m["replication_rtt_s"] / rtt_n, 3)
                 if rtt_n else None),

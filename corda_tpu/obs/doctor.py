@@ -99,6 +99,13 @@ _SHED_DOMINATED = 0.2
 # job; above it one host is soaking the traffic — a slow host attracting
 # hedged re-dispatches, or a depth signal gone stale.
 _HOST_IMBALANCE_SKEW = 0.25
+# Elections won plus leader step-downs summed across members at/above
+# this count in one run is churn: a healthy loadtest elects each group's
+# leader ONCE and keeps it (sum ~= group count, and sharded runs top out
+# at 4 groups), so 5 clears every clean shape while real disturbance —
+# partition flap, starved heartbeats, a rejoiner spinning terms — blows
+# straight past it.
+_ELECTION_CHURN_MIN = 5
 
 # ---------------------------------------------------------------------------
 # The rule table: cause -> the suggested next experiment. Causes either
@@ -155,6 +162,13 @@ RULES: dict = {
         "the verify stage dominates: raise device routing (sidecar "
         "cross-process coalescing, bucket ladder) so signatures leave "
         "the host tier"),
+    "election_churn": (
+        "harden leadership against disturbance: arm [raft] prevote=true "
+        "(the pre-vote canvass stops a partitioned rejoiner deposing a "
+        "live leader; check-quorum makes a quorumless leader cede) and "
+        "A/B the partition_chaos bench — max_term_inflation should "
+        "collapse to ~0 with prevote on while the noprevote leg tracks "
+        "the cut count"),
     "host_imbalance": (
         "rebalance weights / raise hedge threshold: the federation "
         "router is concentrating verify traffic on a subset of hosts — "
@@ -305,6 +319,31 @@ def _pipeline_enabled(stamps) -> bool:
     return False
 
 
+def _merge_raft_health(stamps) -> dict | None:
+    """Fold each member's nested raft stamp into one leadership-health
+    view: elections won, step-downs, term spread and the prevote flag.
+    None when no member carried a raft stamp (host-only sections)."""
+    rafts = [s.get("raft") for s in stamps
+             if isinstance(s, dict) and isinstance(s.get("raft"), dict)]
+    if not rafts:
+        return None
+
+    def total(key):
+        return sum(int(_finite(r.get(key)) or 0) for r in rafts)
+
+    return {
+        "members": len(rafts),
+        "elections_won": total("elections_won"),
+        "leader_stepdowns": total("leader_stepdowns"),
+        "checkquorum_stepdowns": total("checkquorum_stepdowns"),
+        "prevote_rejections": total("prevote_rejections"),
+        "max_term": max(int(_finite(r.get("term")) or 0) for r in rafts),
+        "max_commit_index": max(int(_finite(r.get("commit_index")) or 0)
+                                for r in rafts),
+        "prevote": any(bool(r.get("prevote")) for r in rafts),
+    }
+
+
 def _candidates(signals: dict) -> list[dict]:
     out: list[dict] = []
     pipelined = bool(signals.get("pipeline_enabled"))
@@ -408,6 +447,27 @@ def _candidates(signals: dict) -> list[dict]:
                             "hedges": fed.get("hedges")},
                         "next_experiment": _suggest("host_imbalance")})
 
+    # Rule: election churn -> prevote/check-quorum hardening. A healthy
+    # run elects each group's leader once and keeps it; repeated
+    # elections or step-downs mean leadership is being disturbed
+    # (partition flap, starved heartbeats, a rejoiner forcing terms up).
+    # Abstains below MIN_ATTRIBUTION_ROUNDS committed entries — a
+    # near-idle cluster's bootstrap elections are not churn evidence.
+    raft = signals.get("raft_health") or {}
+    churn = ((raft.get("elections_won") or 0)
+             + (raft.get("leader_stepdowns") or 0))
+    if raft and churn >= _ELECTION_CHURN_MIN \
+            and (raft.get("max_commit_index") or 0) \
+            >= MIN_ATTRIBUTION_ROUNDS:
+        out.append({
+            "cause": "election_churn",
+            "score": round(0.5 + 0.5 * min(1.0, churn / 10.0), 4),
+            "evidence": {k: raft.get(k) for k in (
+                "elections_won", "leader_stepdowns",
+                "checkquorum_stepdowns", "prevote_rejections",
+                "max_term", "members", "prevote")},
+            "next_experiment": _suggest("election_churn")})
+
     # Deterministic ranking: score desc, then cause name — two equal
     # scores can't flap the verdict between runs.
     out.sort(key=lambda c: (-c["score"], c["cause"]))
@@ -459,6 +519,7 @@ def stamp_attribution(node_stamps: dict | None) -> dict:
         "round_breakdown": _merge_breakdowns(breakdowns),
         "admission": {"admitted": admitted, "shed": shed},
         "pipeline_enabled": _pipeline_enabled(stamps),
+        "raft_health": _merge_raft_health(stamps),
         "federation": _merge_federation(
             [(s.get("sidecar") or {}).get("federation") for s in stamps]),
     }
@@ -603,6 +664,9 @@ def extract_signals(artifact: dict) -> dict:
         if merged:
             signals["round_breakdown"] = merged
         signals["pipeline_enabled"] = _pipeline_enabled(stamps.values())
+        raft = _merge_raft_health(stamps.values())
+        if raft:
+            signals["raft_health"] = raft
     # Fall back to the roundtrip probe's routing split when the flagship
     # carried no stamps (the r05_a shape): it exercised the same verify
     # plane, so its device/host split is honest occupancy evidence.
@@ -754,6 +818,15 @@ def _hoist_metrics(artifact: dict, kind: str) -> dict:
         if isinstance(chaos, dict):
             put("leader_kill_recovery_s",
                 chaos.get("leader_kill_recovery_s"))
+        part = artifact.get("partition_chaos")
+        if isinstance(part, dict):
+            put("recovery_s", part.get("recovery_s"))
+            put("max_term_inflation", part.get("max_term_inflation"))
+            put("partition_minority_commits",
+                part.get("minority_commits"))
+            put("partition_lost_acks", part.get("lost_acks"))
+            if isinstance(part.get("history_linearizable"), bool):
+                m["history_linearizable"] = part["history_linearizable"]
     elif kind == "flagship_capture":
         flagship = artifact.get("raft_validating_3node_sidecar") or {}
         put("flagship_tx_per_sec", flagship.get("tx_per_sec"))
@@ -882,6 +955,17 @@ DEFAULT_POLICY: dict = {
     "exactly_once_all": {"direction": "equal"},
     "parity_ok_all": {"direction": "equal"},
     "slo_met": {"direction": "equal"},
+    # Partition plane (round 20): heal-to-first-commit recovery and the
+    # prevote term-inflation bound are banded; the history auditor's
+    # verdict is a hard flag — a run that stops being linearizable is a
+    # regression regardless of magnitude. minority_commits / lost_acks
+    # regress when they grow above a prior zero, but a zero prior passes
+    # _compare vacuously, so the auditor flag is the real gate bit.
+    "recovery_s": {"direction": "lower", "pct": 20.0},
+    "max_term_inflation": {"direction": "lower", "pct": 20.0},
+    "partition_minority_commits": {"direction": "lower", "pct": 20.0},
+    "partition_lost_acks": {"direction": "lower", "pct": 20.0},
+    "history_linearizable": {"direction": "equal"},
 }
 
 

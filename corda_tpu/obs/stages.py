@@ -79,9 +79,11 @@ STAGES = ("admission_wait", "epoch_wait", "queue_wait", "lane_queue_wait",
 # qos_flush marks a deadline-triggered early flush/seal at one of the
 # three QoS queueing points (attrs["point"] names which); shard_handoff
 # is recorded once per completed reshard handoff by the source-group
-# coordinator (attrs carry epoch/from/to/frames).
+# coordinator (attrs carry epoch/from/to/frames); election is recorded
+# by the NEW leader once per won election, spanning candidacy start to
+# the win (attrs carry term/prevote — partition plane, round 20).
 MARKER_SPANS = ("raft_commit", "notary_process", "qos_flush",
-                "shard_handoff")
+                "shard_handoff", "election")
 
 # Dynamic span families: a recorded name may start with one of these
 # prefixes (the root flow span is f"flow:{FlowClassName}").

@@ -125,6 +125,13 @@ COUNTER_NAMES = (
     # verdicts produced, and regressions the trajectory gate flagged.
     "doctor_runs_total",
     "doctor_gate_regressions_total",
+    # Partition plane (round 20): pre-vote canvasses run / rejected
+    # (services/raft.py), leaders deposed by check-quorum, and partition
+    # cut activations from the fault engine (testing/faults.py).
+    "raft_prevotes_total",
+    "raft_prevote_rejections_total",
+    "raft_checkquorum_stepdowns_total",
+    "partition_cuts_total",
 )
 
 HISTOGRAM_NAMES = (

@@ -22,7 +22,18 @@ point               fired from                                     actions
 ``netmap.refresh``  Node ``refresh_netmap`` (directory reload)     drop, stall, crash
 ``disk.corrupt``    raft log read path, checkpoint restore read    flip (seeded bit-flip on read)
 ``disk.full``       raft append / uniqueness-provider commit       full, stall, crash
+``transport.partition`` inmem ``_transmit``/``pump``, tcp ``send``/``_dispatch``  schedule-driven cut (see below)
 ==================  =============================================  =======================================
+
+``transport.partition`` is NOT rule-driven: a plan carries a list of
+:class:`PartitionSpec` entries (symmetric ``split``, one-way ``asym``,
+toggling ``flap``) whose activity is a pure function of the point's
+event counter — both transports offer every frame to
+:func:`fire_partition` and drop it while a cut covering the
+(sender, recipient) pair is live.  ``bind_partition_nodes`` resolves
+auto-sided specs over the cluster identities; ``heal_partitions`` lifts
+every cut.  TOML plans declare them as ``[[partition]]`` tables
+(``kind`` / ``a`` / ``b`` / ``after`` / ``duration`` / ``period``).
 
 ``shard.handoff`` crash is the coordinator-death-mid-handoff case (the
 next leader of the source group re-runs the idempotent sequence);
@@ -66,6 +77,7 @@ __all__ = [
     "POINTS",
     "FaultRule",
     "FaultPlan",
+    "PartitionSpec",
     "ACTIVE",
     "arm",
     "disarm",
@@ -74,6 +86,10 @@ __all__ = [
     "fire_fsync",
     "fire_disk_corrupt",
     "fire_disk_full",
+    "fire_partition",
+    "partitioned",
+    "bind_partition_nodes",
+    "heal_partitions",
     "plan_from_toml",
     "arm_from_env",
     "builtin_plan",
@@ -83,6 +99,7 @@ __all__ = [
 POINTS = (
     "transport.send",
     "transport.recv",
+    "transport.partition",
     "raft.append",
     "raft.fsync",
     "verify.device",
@@ -120,6 +137,59 @@ class FaultRule:
         return self.max_fires > 0 and self.fires >= self.max_fires
 
 
+@dataclass
+class PartitionSpec:
+    """One scheduled network partition (the ``transport.partition`` point).
+
+    Scheduling is EVENT-counted, not wall-clocked: every frame offered to
+    ``fire_partition`` advances the point's event counter, and a spec is
+    active as a pure function of that counter — two runs of the same plan
+    over the same traffic cut identically, with no timing dependence.
+
+    ``kind``:
+      * ``split`` — symmetric split-brain: frames between side ``a`` and
+        side ``b`` drop in BOTH directions while the cut holds.
+      * ``asym`` — one-way cut: frames from ``a`` to ``b`` drop; ``b`` to
+        ``a`` still delivers (the half-open link Raft's paper warns about).
+      * ``flap`` — a ``split`` that toggles every ``period`` events; a
+        ``period`` of 0 derives one deterministically from the plan seed.
+
+    Sides hold node identities (``str(transport address)`` — both
+    transports offer their address objects and the engine normalizes
+    with ``str()``, so TcpAddress and InMemoryAddress mix-ins match
+    however a hook spells the endpoint). Empty sides resolve at
+    ``bind_partition_nodes`` time: ``split``/``flap`` put the FIRST
+    ``n//2`` bound ids on side ``a`` (the minority when n is odd, so a
+    harness that binds the leader first proves the minority-leader case);
+    ``asym`` isolates the first id's egress.
+    """
+
+    kind: str                     # split | asym | flap
+    a: tuple = ()                 # side-a identities (empty = auto)
+    b: tuple = ()                 # side-b identities (empty = auto)
+    after: int = 0                # events before the cut arms
+    duration: int = 0             # events the cut (or flap phase) spans;
+    #                               0 = held until heal_partitions()
+    period: int = 0               # flap half-cycle in events (0 = seeded)
+
+    def active(self, seen: int) -> bool:
+        """Pure schedule query: is this cut live after *seen* events?"""
+        since = seen - self.after
+        if since <= 0:
+            return False
+        if self.duration > 0 and since > self.duration:
+            return False
+        if self.kind == "flap":
+            return ((since - 1) // max(1, self.period)) % 2 == 0
+        return True
+
+    def cuts(self, src: str, dst: str) -> bool:
+        """Does this spec drop a *src* -> *dst* frame while active?"""
+        if src in self.a and dst in self.b:
+            return True
+        return self.kind != "asym" and src in self.b and dst in self.a
+
+
 class FaultPlan:
     """A seeded set of fault rules, armed process-wide via :func:`arm`.
 
@@ -130,7 +200,8 @@ class FaultPlan:
     """
 
     def __init__(self, seed: int, rules: list[FaultRule],
-                 node_name: str | None = None):
+                 node_name: str | None = None,
+                 partitions: list[PartitionSpec] | None = None):
         self.seed = int(seed)
         self.node_name = node_name
         self._lock = threading.Lock()
@@ -152,6 +223,85 @@ class FaultPlan:
         self._by_point: dict[str, list[FaultRule]] = {}
         for rule in self.rules:
             self._by_point.setdefault(rule.point, []).append(rule)
+        self.partitions: list[PartitionSpec] = list(partitions or [])
+        for idx, spec in enumerate(self.partitions):
+            if spec.kind not in ("split", "asym", "flap"):
+                raise ValueError(f"unknown partition kind {spec.kind!r}")
+            spec.a, spec.b = tuple(spec.a), tuple(spec.b)
+            if spec.kind == "flap" and spec.period <= 0:
+                # The seeded flap period the docstring promises.
+                spec.period = random.Random(
+                    f"{self.seed}:transport.partition:flap:{idx}"
+                ).randrange(40, 160)
+        self._partitions_healed = False
+        # Edge-detection state per spec: a cut transition (inactive ->
+        # active) counts once as "transport.partition:cut".
+        self._partition_was_active = [False] * len(self.partitions)
+
+    # -- the transport.partition point -------------------------------------
+
+    def bind_partition_nodes(self, node_ids) -> None:
+        """Resolve auto (empty-sided) partition specs over the cluster's
+        identities, in the caller's order — the harness decides which
+        side the leader lands on by binding it first."""
+        ids = tuple(str(n) for n in node_ids)
+        with self._lock:
+            for spec in self.partitions:
+                if spec.a and spec.b:
+                    continue
+                if spec.kind == "asym":
+                    spec.a, spec.b = ids[:1], ids[1:]
+                else:
+                    spec.a, spec.b = ids[:len(ids) // 2], ids[len(ids) // 2:]
+
+    def heal_partitions(self) -> None:
+        """Permanently lift every cut (the harness's timed heal)."""
+        with self._lock:
+            self._partitions_healed = True
+
+    def fire_partition(self, src, dst) -> bool:
+        """Record one frame event at ``transport.partition``; return True
+        when an active cut drops the *src* -> *dst* frame.  Unlike
+        :meth:`partitioned` this ADVANCES the schedule — call it exactly
+        once per offered frame."""
+        src, dst = str(src), str(dst)
+        with self._lock:
+            self.events["transport.partition"] = seen = \
+                self.events.get("transport.partition", 0) + 1
+            if self._partitions_healed or not self.partitions:
+                return False
+            drop = False
+            for idx, spec in enumerate(self.partitions):
+                live = spec.active(seen)
+                if live and not self._partition_was_active[idx]:
+                    self.counters["transport.partition:cut"] = \
+                        self.counters.get("transport.partition:cut", 0) + 1
+                    try:  # telemetry is best-effort from the fault engine
+                        from ..obs import telemetry as _tm
+
+                        _tm.inc("partition_cuts_total")
+                    # lint: allow(no-silent-except) the fault engine sits inside every transport send — a broken/partially-imported telemetry module must cost the counter, never the frame
+                    except Exception:  # noqa: BLE001 - never fail a frame
+                        pass
+                self._partition_was_active[idx] = live
+                if live and spec.cuts(src, dst):
+                    drop = True
+            if drop:
+                self.counters["transport.partition:drop"] = \
+                    self.counters.get("transport.partition:drop", 0) + 1
+            return drop
+
+    def partitioned(self, src, dst) -> bool:
+        """Pure query: would a *src* -> *dst* frame drop RIGHT NOW?  Never
+        advances the event counter — safe for polling (the TCP bridge
+        parks on this instead of spin-resending across a held cut)."""
+        src, dst = str(src), str(dst)
+        with self._lock:
+            if self._partitions_healed:
+                return False
+            seen = self.events.get("transport.partition", 0)
+            return any(spec.active(seen) and spec.cuts(src, dst)
+                       for spec in self.partitions)
 
     def fire(self, point: str) -> tuple[str, float] | None:
         """Record one event at *point*; return ``(action, delay_s)`` when a
@@ -213,6 +363,33 @@ def fire(point: str) -> tuple[str, float] | None:
     """Convenience: fire *point* against the armed plan, if any."""
     plan = ACTIVE
     return plan.fire(point) if plan is not None else None
+
+
+def fire_partition(src, dst) -> bool:
+    """Hook body for ``transport.partition``: True = drop the frame.
+    Counts one schedule event; call once per offered frame."""
+    plan = ACTIVE
+    return plan.fire_partition(src, dst) if plan is not None else False
+
+
+def partitioned(src, dst) -> bool:
+    """Pure cut query against the armed plan (no schedule advance)."""
+    plan = ACTIVE
+    return plan.partitioned(src, dst) if plan is not None else False
+
+
+def bind_partition_nodes(node_ids) -> None:
+    """Resolve auto partition sides on the armed plan, if any."""
+    plan = ACTIVE
+    if plan is not None:
+        plan.bind_partition_nodes(node_ids)
+
+
+def heal_partitions() -> None:
+    """Lift every cut on the armed plan, if any."""
+    plan = ACTIVE
+    if plan is not None:
+        plan.heal_partitions()
 
 
 def fire_fsync(point: str) -> None:
@@ -295,7 +472,17 @@ def plan_from_toml(text: str, node_name: str | None = None) -> FaultPlan:
             max_fires=int(raw.get("max_fires", 0)),
             node=raw.get("node"),
         ))
-    return FaultPlan(seed, rules, node_name=node_name)
+    partitions = []
+    for raw in data.get("partition", []):
+        partitions.append(PartitionSpec(
+            kind=raw["kind"],
+            a=tuple(raw.get("a", ())),
+            b=tuple(raw.get("b", ())),
+            after=int(raw.get("after", 0)),
+            duration=int(raw.get("duration", 0)),
+            period=int(raw.get("period", 0)),
+        ))
+    return FaultPlan(seed, rules, node_name=node_name, partitions=partitions)
 
 
 def arm_from_env(node_name: str | None = None) -> FaultPlan | None:
@@ -313,7 +500,35 @@ def arm_from_env(node_name: str | None = None) -> FaultPlan | None:
 
 def builtin_plan(name: str, node_name: str | None = None) -> FaultPlan:
     """Named plans for the chaos loadtest / bench (``lossy``, ``slow-disk``,
-    ``flaky-device``, ``reshard``, ``bitrot``)."""
+    ``flaky-device``, ``reshard``, ``bitrot``, and the partition family
+    ``split-brain`` / ``asym`` / ``flap`` — also reachable as
+    ``partition.<name>`` for CLI pass-through)."""
+    if name.startswith("partition."):
+        name = name[len("partition."):]
+    if name == "split-brain":
+        # Symmetric split-brain with the familiar lossy rule riding along
+        # (partitions and probabilistic rules compose in one plan): the
+        # cut arms after 200 offered frames, holds for 2500, then heals —
+        # the majority side must keep committing, the minority none.
+        return FaultPlan(29, [
+            FaultRule("transport.send", "drop", p=0.02, max_fires=200),
+        ], node_name=node_name, partitions=[
+            PartitionSpec("split", after=200, duration=2500),
+        ])
+    if name == "asym":
+        # One-way cut: the first bound node can still HEAR the cluster
+        # but nothing it sends gets out — the half-open link that makes
+        # naive elections churn.
+        return FaultPlan(31, [], node_name=node_name, partitions=[
+            PartitionSpec("asym", after=200, duration=2000),
+        ])
+    if name == "flap":
+        # Flapping split with a seeded half-cycle: the cut toggles every
+        # `period` frames for 4000 frames — the rejoin-storm shape that
+        # pre-vote exists to keep from inflating terms.
+        return FaultPlan(37, [], node_name=node_name, partitions=[
+            PartitionSpec("flap", after=200, duration=4000),
+        ])
     if name == "lossy":
         # ~5% send-side loss; durable outbox re-poll recovers each loss
         # within ~1s, so the run completes with elevated tail latency.

@@ -407,6 +407,20 @@ def run_chaos_loadtest(
         else:
             raise RuntimeError("raft cluster(s) failed to elect")
 
+        if plan_obj is not None and plan_obj.partitions:
+            # Auto-sided partition specs bind over the live cluster,
+            # LEADER first: the builtins put the first n//2 identities on
+            # side a, so the acting leader of group 0 lands in the
+            # minority and the cut proves leader deposition, not just
+            # follower lag. The client stays outside every cut.
+            ordered = sorted(
+                group_nodes[0],
+                key=lambda n: n.raft_member.role != "leader")
+            ordered += [n for row in group_nodes[1:] for n in row]
+            plan_obj.bind_partition_nodes(
+                [n.messaging.my_address for n in ordered])
+            disruptions.append("partition sides bound (leader first)")
+
         target = notaries[0].identity
         # Mixed workload: every round(1/cross_frac)-th move consumes TWO
         # issued states owned by DIFFERENT shards (the 2PC path); the rest
@@ -588,6 +602,325 @@ def run_chaos_loadtest(
             faults.disarm()
         if armed_here is not None:
             _obs.disarm()
+
+
+@dataclass
+class PartitionResult:
+    """One partition soak: cut -> hold -> heal, with the client history
+    audited against the ledger (testing/history.py)."""
+
+    plan: str
+    prevote: bool
+    isolate: str            # leader | follower (who the cut puts alone)
+    cluster_size: int
+    tx_requested: int
+    tx_committed: int
+    tx_rejected: int
+    tx_unresolved: int
+    duration_s: float
+    cut_at_s: float
+    healed_at_s: float | None
+    # Heal -> first post-heal commit completion (the recovery observable
+    # the bench gates on; None = nothing completed after the heal).
+    recovery_s: float | None
+    # Max member term delta across the soak: bounded with prevote on,
+    # grows with every futile minority timeout with it off.
+    term_before: int = 0
+    term_after: int = 0
+    max_term_inflation: int = 0
+    # Ledger advance observed on the minority side WHILE the cut held
+    # (MUST be 0 — a lone leader applying state is the split-brain bug).
+    minority_commits_during_cut: int = 0
+    # Summed member stamps (raft.py round-20 counters).
+    elections_won: int = 0
+    prevotes: int = 0
+    prevote_rejections: int = 0
+    checkquorum_stepdowns: int = 0
+    leader_stepdowns: int = 0
+    # Fault-engine counters: cut transitions + frames eaten by cuts.
+    partition_cuts: int = 0
+    partition_drops: int = 0
+    # Auditor verdict (check_history) — the flat gate bit plus evidence.
+    history_linearizable: bool = False
+    history_events: int = 0
+    lost_acks: int = 0
+    double_spends: int = 0
+    fail_conflicts: int = 0
+    unresolved_ops: int = 0
+    history: dict = field(default_factory=dict)
+    disruptions: list = field(default_factory=list)
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+
+def run_partition_loadtest(
+    plan=None,  # FaultPlan | builtin name | plan TOML path | None = held split
+    n_tx: int = 60,
+    cluster_size: int = 3,
+    prevote: bool = True,
+    isolate: str = "leader",  # who the auto-bound minority side holds
+    precut_frac: float = 0.25,  # txs committed BEFORE the cut arms
+    cut_hold_s: float = 6.0,  # wall-clock hold before the timed heal
+    verifier: str = "cpu",
+    batch: BatchConfig | None = None,
+    base_dir: str | None = None,
+    max_seconds: float = 150.0,
+    retry_deadline_s: float = 45.0,
+) -> PartitionResult:
+    """Partition soak: an in-process raft cluster over real TCP commits a
+    pre-cut tranche, then a deterministic network partition isolates the
+    leader (or a follower), holds for ``cut_hold_s``, and heals. Every
+    client invocation and outcome lands in a :class:`testing.history`
+    History; after the drain the checker replays it against the union of
+    every member's committed rows — acked-then-lost commits, cross-side
+    double spends, lying rejections and ledger advance on the minority
+    side all fail the run's ``history_linearizable`` bit.
+
+    ``isolate="leader"`` proves the check-quorum story (a quorumless
+    leader must stop answering); ``isolate="follower"`` proves the
+    pre-vote story (a cut-off follower must not inflate the term and
+    depose the healthy leader at heal) — run it with ``prevote`` on and
+    off for the A/B the bench reports."""
+    from ..node.config import RaftConfig
+    from ..serialization.codec import deserialize
+    from ..testing import faults
+    from ..testing.history import History, check_history
+    from ..flows.notary import (NotaryException, NotaryUnavailable,
+                                OverloadedError, WrongShardEpoch)
+
+    if isolate not in ("leader", "follower"):
+        raise ValueError(f"isolate: expected leader|follower, got {isolate!r}")
+    if plan is None:
+        # Held symmetric split: active from the first post-arm frame,
+        # lifted only by the timed heal below — the cut window is the
+        # harness's wall clock, the cut itself stays event-deterministic.
+        plan_obj = faults.FaultPlan(29, [], partitions=[
+            faults.PartitionSpec("split")])
+        plan_name = "split-hold"
+    elif isinstance(plan, faults.FaultPlan):
+        plan_obj, plan_name = plan, "custom"
+    else:
+        p = Path(str(plan))
+        if p.suffix == ".toml" or p.exists():
+            plan_obj = faults.plan_from_toml(p.read_text(encoding="utf-8"))
+        else:
+            plan_obj = faults.builtin_plan(str(plan))
+        plan_name = str(plan)
+    if not plan_obj.partitions:
+        raise ValueError("partition soak needs a plan with [[partition]] "
+                         "specs (see faults.builtin_plan('split-brain'))")
+
+    base = Path(base_dir or tempfile.mkdtemp(prefix="corda-tpu-part-"))
+    batch = batch or BatchConfig()
+    raft_cfg = RaftConfig(prevote=prevote)
+    disruptions: list[str] = []
+    history = History()
+    cluster = tuple(f"Raft{i}" for i in range(cluster_size))
+    notaries = [_make_node(base, name, notary="raft-simple",
+                           raft_cluster=cluster, verifier=verifier,
+                           batch=batch, raft=raft_cfg)
+                for name in cluster]
+    client = _make_node(base, "PartitionClient", verifier=verifier,
+                        batch=batch)
+    nodes = notaries + [client]
+    try:
+        for n in nodes:
+            n.refresh_netmap()
+        deadline = time.monotonic() + 30.0
+        leader = None
+        while time.monotonic() < deadline:
+            for n in nodes:
+                n.run_once(timeout=0.005)
+            leader = next((n for n in notaries
+                           if n.raft_member.role == "leader"), None)
+            if leader is not None:
+                break
+        if leader is None:
+            raise RuntimeError("raft cluster failed to elect")
+
+        target = notaries[0].identity
+        stxs = []
+        for i in range(n_tx):
+            builder = DummyContract.generate_initial(
+                client.identity.ref((i % (1 << 30)).to_bytes(4, "big")),
+                i, target)
+            builder.sign_with(client.key)
+            issue_stx = builder.to_signed_transaction()
+            client.services.record_transactions([issue_stx])
+            prior = issue_stx.tx.out_ref(0)
+            move = DummyContract.move(prior, client.identity.owning_key)
+            move.sign_with(client.key)
+            stxs.append((move.to_signed_transaction(
+                check_sufficient_signatures=False), prior))
+
+        t0 = time.perf_counter()
+        completions: list[float] = []
+        handles: list = []
+        cut_at: float | None = None
+        healed_at: float | None = None
+
+        def _submit(i: int) -> None:
+            stx, prior = stxs[i]
+            history.record_invoke(
+                "PartitionClient", f"tx{i}", str(stx.id),
+                refs=(str(prior.ref),), t=time.perf_counter() - t0,
+                during_cut=cut_at is not None and healed_at is None)
+            h = client.start_flow(RetryingNotariseFlow(
+                stx, retry_deadline_s))
+            h.result.add_done_callback(
+                lambda _f: completions.append(time.perf_counter() - t0))
+            handles.append(h)
+
+        # Phase A: the pre-cut tranche commits against the healthy
+        # cluster (proves the baseline, seeds the ledger).
+        precut = max(1, min(n_tx, int(round(n_tx * precut_frac))))
+        for i in range(precut):
+            _submit(i)
+        phase_deadline = time.monotonic() + max_seconds / 3
+        while time.monotonic() < phase_deadline:
+            for n in nodes:
+                n.run_once(timeout=0.002)
+            if all(h.result.done for h in handles):
+                break
+
+        # Arm the cut with the ISOLATED node bound first (auto-sided
+        # specs put the first n//2 identities on side a — the minority).
+        isolated = leader if isolate == "leader" else next(
+            n for n in notaries if n.raft_member.role != "leader")
+        ordered = [isolated] + [n for n in notaries if n is not isolated]
+        minority = ordered[:max(1, len(ordered) // 2)]
+        plan_obj.bind_partition_nodes(
+            [n.messaging.my_address for n in ordered])
+        faults.arm(plan_obj)
+        cut_at = time.perf_counter() - t0
+        term_before = max(n.raft_member.term for n in notaries)
+        minority_base = sum(
+            n.uniqueness_provider.committed_count for n in minority)
+        minority_commits = 0
+        disruptions.append(
+            f"cut armed at {cut_at:.2f}s isolating "
+            f"{[n.config.name for n in minority]} ({isolate})")
+
+        # Phase B: the rest of the workload rides through cut + heal.
+        for i in range(precut, n_tx):
+            _submit(i)
+        run_deadline = time.monotonic() + max_seconds
+        while time.monotonic() < run_deadline:
+            for n in nodes:
+                n.run_once(timeout=0.002)
+            now = time.perf_counter() - t0
+            if healed_at is None:
+                # While the cut holds: the minority's ledger must not
+                # advance (sampled every pump pass — one COUNT(*) per
+                # minority member against a page-cached sqlite).
+                minority_commits = max(minority_commits, sum(
+                    n.uniqueness_provider.committed_count
+                    for n in minority) - minority_base)
+                if now >= cut_at + cut_hold_s:
+                    faults.heal_partitions()
+                    healed_at = now
+                    disruptions.append(f"healed at {healed_at:.2f}s")
+            elif all(h.result.done for h in handles):
+                break
+        duration = time.perf_counter() - t0
+
+        committed = rejected = unresolved = 0
+        for i, h in enumerate(handles):
+            if not h.result.done:
+                unresolved += 1
+                kind = "timeout"
+            elif h.result.exception() is None:
+                committed += 1
+                kind = "ok"
+            else:
+                exc = h.result.exception()
+                # A retry-deadline exhaustion re-raises the last RETRYABLE
+                # error (unavailable/shed/fence) — that decided NOTHING
+                # about the tx, so the history records an ambiguous
+                # timeout the checker resolves against the ledger. Only a
+                # FINAL notary error (conflict, invalid) is a "fail".
+                final = (isinstance(exc, NotaryException)
+                         and not isinstance(exc.error, (
+                             NotaryUnavailable, OverloadedError,
+                             WrongShardEpoch)))
+                rejected += 1
+                kind = "fail" if final else "timeout"
+            history.record_outcome("PartitionClient", f"tx{i}", kind,
+                                   t=duration)
+
+        recovery = None
+        if healed_at is not None:
+            after = [t for t in completions if t > healed_at]
+            recovery = round(min(after) - healed_at, 3) if after else None
+
+        # Ledger side of the audit: the union of every member's
+        # committed rows (ref -> consuming tx), read while members live.
+        consumed = []
+        committed_tx_ids = set()
+        for n in notaries:
+            with n.db.lock:
+                rows = n.db.conn.execute(
+                    "SELECT state_ref, consuming FROM committed_states"
+                ).fetchall()
+            for ref_blob, consuming in rows:
+                tx = deserialize(consuming)
+                consumed.append((bytes(ref_blob).hex(), str(tx.id)))
+                committed_tx_ids.add(str(tx.id))
+        # History refs are str(StateRef) while ledger refs are serialized
+        # blobs — the double-spend scan only needs ref keys CONSISTENT
+        # across members, which the blob hex is.
+        verdict = check_history(history, committed_tx_ids, consumed,
+                                minority_commits=minority_commits)
+
+        term_after = max(n.raft_member.term for n in notaries)
+        stamps = [n.raft_member.stamp() for n in notaries]
+        injected = plan_obj.injected()
+        result = PartitionResult(
+            plan=plan_name,
+            prevote=prevote,
+            isolate=isolate,
+            cluster_size=cluster_size,
+            tx_requested=n_tx,
+            tx_committed=committed,
+            tx_rejected=rejected,
+            tx_unresolved=unresolved,
+            duration_s=round(duration, 3),
+            cut_at_s=round(cut_at, 3),
+            healed_at_s=round(healed_at, 3) if healed_at is not None
+            else None,
+            recovery_s=recovery,
+            term_before=term_before,
+            term_after=term_after,
+            max_term_inflation=term_after - term_before,
+            minority_commits_during_cut=minority_commits,
+            elections_won=sum(s["elections_won"] for s in stamps),
+            prevotes=sum(s["prevotes"] for s in stamps),
+            prevote_rejections=sum(s["prevote_rejections"]
+                                   for s in stamps),
+            checkquorum_stepdowns=sum(s["checkquorum_stepdowns"]
+                                      for s in stamps),
+            leader_stepdowns=sum(s["leader_stepdowns"] for s in stamps),
+            partition_cuts=injected.get("transport.partition:cut", 0),
+            partition_drops=injected.get("transport.partition:drop", 0),
+            history_linearizable=verdict["history_linearizable"],
+            history_events=verdict["events"],
+            lost_acks=len(verdict["lost_acks"]),
+            double_spends=len(verdict["double_spends"]),
+            fail_conflicts=len(verdict["fail_conflicts"]),
+            unresolved_ops=len(verdict["unresolved"]),
+            history=verdict,
+            disruptions=disruptions,
+        )
+        return result
+    finally:
+        faults.disarm()
+        for n in nodes:
+            try:
+                n.stop()
+            # lint: allow(no-silent-except) harness teardown: a node that dies mid-stop already produced its result; not a production verify/notarise path
+            except Exception:
+                pass
 
 
 @dataclass
@@ -2210,8 +2543,11 @@ def main(argv=None) -> int:
                          "closed loop")
     ap.add_argument("--chaos", default=None, metavar="PLAN",
                     help="chaos mode: arm a fault plan (lossy | slow-disk | "
-                         "flaky-device | path to a plan TOML) and notarise "
-                         "through the retrying client flow")
+                         "flaky-device | bitrot | partition.split-brain | "
+                         "partition.asym | partition.flap | path to a plan "
+                         "TOML) and notarise through the retrying client "
+                         "flow; partition.* plans auto-bind their cut sides "
+                         "leader-first over the live cluster")
     ap.add_argument("--kill-leader", action="store_true",
                     help="chaos mode: kill the raft LEADER mid-burst and "
                          "measure recovery (implies chaos mode)")

@@ -33,6 +33,8 @@ def _stub_phases(monkeypatch):
                  "bench_telemetry",  # ditto: an in-process loadtest round
                  "bench_reshard",  # ditto: live split + merge in-process nets
                  "bench_durability",  # ditto: a bitrot chaos soak + fsck
+                 "bench_partition_chaos",  # ditto: a THREE-leg split-brain
+                 # soak (leader cut + prevote A/B) over real TCP clusters
                  "bench_doctor",  # unstubbed, this one APPENDS to the
                  # checked-in artifacts/TRAJECTORY.jsonl from every report
                  # test — test pollution in the working tree
@@ -102,6 +104,9 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # The durability section (round 14) rides the device phase path — the
     # host-only path asserts it separately; schema parity both ways.
     assert report["durability"] == {"stub": "bench_durability"}
+    # The partition-chaos section (round 20) rides the device phase path —
+    # the host-only path asserts it separately; schema parity both ways.
+    assert report["partition_chaos"] == {"stub": "bench_partition_chaos"}
     # The perf-doctor section (round 17) rides the device phase path —
     # the host-only path asserts it separately; schema parity both ways.
     assert report["doctor"] == {"stub": "bench_doctor"}
@@ -175,6 +180,7 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
     assert report["baseline_configs"]["raft_validating_3node"] == {
         "stub": "bench_validating_flagship"}
     assert report["durability"] == {"stub": "bench_durability"}
+    assert report["partition_chaos"] == {"stub": "bench_partition_chaos"}
     assert report["cpu_oracle_sigs_per_sec"] == 250.0
     # The doctor runs LAST on the host-only path too — after the
     # cpu_oracle ceiling it diagnoses against.

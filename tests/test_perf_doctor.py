@@ -451,3 +451,68 @@ def test_append_then_load_round_trips(tmp_path):
     doctor.append_trajectory(str(store), rec)
     doctor.append_trajectory(str(store), rec)
     assert doctor.load_trajectory(str(store)) == [rec, rec]
+
+
+# ---------------------------------------------------------------------------
+# Partition plane (round 20): election churn rule + partition_chaos gate
+# ---------------------------------------------------------------------------
+
+
+def _raft_stamp(**kw):
+    base = {"term": 2, "elections_won": 1, "leader_stepdowns": 0,
+            "checkquorum_stepdowns": 0, "prevote_rejections": 0,
+            "commit_index": 100, "prevote": False}
+    base.update(kw)
+    return base
+
+
+def test_election_churn_rule_fires_on_disturbed_leadership():
+    stamps = {f"m{i}": {"raft": _raft_stamp(elections_won=2,
+                                            leader_stepdowns=1,
+                                            term=9)}
+              for i in range(3)}
+    verdict = doctor.stamp_attribution(stamps)
+    churn = next(b for b in verdict["bottlenecks"]
+                 if b["cause"] == "election_churn")
+    assert churn["evidence"]["elections_won"] == 6
+    assert churn["evidence"]["max_term"] == 9
+    assert "prevote" in churn["next_experiment"]
+
+
+def test_election_churn_abstains_on_healthy_or_idle_clusters():
+    # One clean election per group (the winner stamps it; a 4-shard run
+    # sums to 4): not churn.
+    healthy = {f"m{i}": {"raft": _raft_stamp(
+        elections_won=1 if i % 3 == 0 else 0)} for i in range(12)}
+    assert not any(b["cause"] == "election_churn" for b in
+                   doctor.stamp_attribution(healthy)["bottlenecks"])
+    # Plenty of elections but almost no committed work: a near-idle
+    # bootstrap, below the MIN_ATTRIBUTION_ROUNDS abstention floor.
+    idle = {f"m{i}": {"raft": _raft_stamp(elections_won=5,
+                                          commit_index=3)}
+            for i in range(3)}
+    assert not any(b["cause"] == "election_churn" for b in
+                   doctor.stamp_attribution(idle)["bottlenecks"])
+
+
+def test_partition_chaos_metrics_hoist_and_gate_on_linearizability():
+    art = {"metric": "verified_sigs_per_sec", "value": 100.0,
+           "partition_chaos": {"recovery_s": 0.2, "max_term_inflation": 1,
+                               "minority_commits": 0, "lost_acks": 0,
+                               "history_linearizable": True}}
+    rec1 = doctor.normalize_record(art, "r20_a.json")
+    m = rec1["metrics"]
+    assert m["recovery_s"] == 0.2
+    assert m["max_term_inflation"] == 1.0
+    assert m["history_linearizable"] is True
+
+    art2 = dict(art)
+    art2["partition_chaos"] = dict(
+        art["partition_chaos"], history_linearizable=False,
+        max_term_inflation=9)
+    rec2 = doctor.normalize_record(art2, "r20_b.json")
+    verdict = doctor.gate([rec1, rec2])
+    assert not verdict["ok"]
+    tripped = {r["metric"] for r in verdict["regressions"]}
+    assert "history_linearizable" in tripped  # the hard flag
+    assert "max_term_inflation" in tripped    # the banded A/B bound

@@ -1,0 +1,177 @@
+"""Pre-vote + check-quorum hardening (partition plane, round 20).
+
+Unit tier over the in-process router (no sockets): the pre-vote canvass
+(Raft §9.6) persists NOTHING — a disturbed member that cannot win a real
+election never inflates its term or deposes a live leader; check-quorum
+makes a leader that lost its majority cede instead of serving a
+minority. With ``prevote=False`` (the default) none of the new frames
+exist on the wire and the election path is byte-for-byte the old one.
+"""
+
+import os
+import sys
+
+from corda_tpu.node.config import RaftConfig
+from corda_tpu.node.services.raft import PreVote, RaftMember
+
+sys.path.insert(0, os.path.dirname(__file__))
+from test_raft_group_commit import (  # noqa: E402
+    Net,
+    elect,
+    make_trio,
+)
+
+PREVOTE = RaftConfig(prevote=True)
+
+
+def _keep_leader_fresh(net, leader, t, steps=4, dt=0.06):
+    """Advance time in sub-election steps, ticking only the leader: every
+    follower's leader-contact stamp and the leader's peer-contact stamps
+    stay fresh (heartbeats out, replies back)."""
+    for _ in range(steps):
+        t[0] += dt
+        leader.tick()
+        net.deliver_all()
+
+
+def test_prevote_canvass_persists_nothing_and_cannot_depose(tmp_path):
+    """A follower that hits its election deadline while the leader is
+    LIVE (the rejoined-minority shape): its canvass is rejected by every
+    peer, its term never moves, and the leader keeps its seat."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0], config=PREVOTE)
+    a, b, c = members["A"], members["B"], members["C"]
+    elect(net, a, t)
+    _keep_leader_fresh(net, a, t)
+
+    term_before = c.term
+    c._election_deadline = t[0]  # the disturbance: deadline fires NOW
+    c.tick()
+    net.deliver_all()
+
+    assert c.metrics["prevotes"] == 1  # it canvassed...
+    assert c.role == "follower"        # ...but never became candidate
+    assert c.term == term_before       # and persisted no new term
+    assert a.role == "leader" and a.term == term_before
+    # Both the live leader and the fresh-contact follower rejected it.
+    assert a.metrics["prevote_rejections"] == 1
+    assert b.metrics["prevote_rejections"] == 1
+
+
+def test_prevote_canvass_wins_when_leader_is_gone(tmp_path):
+    """Stale leader contact everywhere -> the canvass is granted, and
+    only THEN does a real (term-persisting) election run and win."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0], config=PREVOTE)
+    a, b = members["A"], members["B"]
+    elect(net, a, t)
+    _keep_leader_fresh(net, a, t)
+
+    # The leader falls silent: contact stamps age past the stickiness
+    # window with nobody heartbeating.
+    t[0] += 1.0
+    term_before = b.term
+    b._election_deadline = t[0]
+    b.tick()
+    net.deliver_all()
+
+    assert b.role == "leader"
+    assert b.metrics["prevotes"] == 1
+    assert b.metrics["elections_won"] == 1
+    # One canvass (term untouched) + one real election (term + 1).
+    assert b.term == term_before + 1
+
+
+def test_checkquorum_leader_without_majority_steps_down(tmp_path):
+    """A leader whose peer-contact stamps all age out cedes leadership
+    instead of serving a minority partition."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0], config=PREVOTE)
+    a = members["A"]
+    elect(net, a, t)
+    _keep_leader_fresh(net, a, t)
+
+    t[0] += 100.0  # every peer reply is now ancient: quorum lost
+    a.tick()
+
+    assert a.role == "follower"
+    assert a.leader_name is None  # stops advertising itself via hints
+    assert a.metrics["checkquorum_stepdowns"] == 1
+    assert a.metrics["leader_stepdowns"] == 1
+
+
+def test_prevote_grant_requires_up_to_date_log(tmp_path):
+    """A canvasser whose log is BEHIND is rejected even with no live
+    leader — same up-to-date rule as a real vote (§5.4.1)."""
+    from test_raft_group_commit import cmd, settle
+
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0], config=PREVOTE)
+    a, b = members["A"], members["B"]
+    elect(net, a, t)
+    a.submit(cmd(b"ref", b"tx", b"r1"))  # B's log gains a real entry
+    settle(net, members.values())
+    t[0] += 1.0  # leader contact stale: liveness cannot be the reason
+
+    behind = PreVote(b.term + 1, "C", last_log_index=0, last_log_term=0)
+    rejections = b.metrics["prevote_rejections"]
+    b._on_prevote(behind, "C")
+    assert b.metrics["prevote_rejections"] == rejections + 1
+
+
+def test_prevote_off_keeps_the_old_election_path(tmp_path):
+    """Default config: a fired deadline starts a REAL election at once
+    (term persists immediately), no PreVote frame ever hits the wire,
+    and a quorumless leader never self-demotes."""
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0])  # prevote=False
+    a, c = members["A"], members["C"]
+    elect(net, a, t)
+
+    term_before = c.term
+    c._election_deadline = t[0]
+    c.tick()  # don't deliver: inspect the raw outbound frames
+    assert c.role == "candidate"  # straight to candidacy...
+    assert c.term == term_before + 1  # ...with the term persisted
+    assert c.metrics["prevotes"] == 0
+    from corda_tpu.serialization.codec import deserialize
+
+    for _to, data in c.messaging.sent:
+        assert not isinstance(getattr(deserialize(data), "payload",
+                                      deserialize(data)), PreVote)
+    net.deliver_all()
+
+    t[0] += 100.0  # ancient peer contact — but check-quorum is off
+    a.tick()
+    assert a.metrics["checkquorum_stepdowns"] == 0
+
+
+def test_single_member_group_never_steps_down(tmp_path):
+    """A solo group is always its own quorum: check-quorum must not
+    depose the only member."""
+    from test_raft_group_commit import make_member
+
+    net, t = Net(), [0.0]
+    solo = make_member(tmp_path, net, "S", {}, lambda: t[0],
+                       config=PREVOTE)
+    t[0] += 100.0
+    solo.tick()
+    net.deliver_all()
+    assert solo.role == "leader"
+    t[0] += 100.0
+    solo.tick()
+    assert solo.role == "leader"
+    assert solo.metrics["checkquorum_stepdowns"] == 0
+
+
+def test_stamp_carries_partition_plane_counters(tmp_path):
+    net, t = Net(), [0.0]
+    members = make_trio(tmp_path, net, lambda: t[0], config=PREVOTE)
+    a = members["A"]
+    elect(net, a, t)
+    stamp = a.stamp()
+    assert stamp["prevote"] is True
+    assert stamp["elections_won"] == 1
+    for key in ("prevotes", "prevote_rejections",
+                "checkquorum_stepdowns"):
+        assert isinstance(stamp[key], int)
