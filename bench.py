@@ -1026,6 +1026,101 @@ def bench_doctor(report):
     return out
 
 
+def bench_autotune(rate_tx_s=2400.0, n_tx=400, workers=2, budget=3,
+                   seed=7):
+    """The autotune plane's section (round 21): the closed loop finding
+    a config that beats the hand-tuned default. One baseline ingest run
+    at defaults produces a REAL doctor verdict (stamp_attribution over
+    the member stamps); the controller maps its top bottleneck's
+    structured experiment spec to a sweep, evaluates ``budget`` gated
+    candidates through the same multiprocess harness (config knobs ride
+    CORDA_TPU_CONFIG_OVERLAY to every spawned node), and commits the
+    winner as a TOML overlay. The headline is best_value vs
+    baseline_value on the swept metric — ">= the hand-tuned default" by
+    construction, because a loop that finds nothing better commits
+    nothing and the incumbent stands.
+
+    The search is replayable: the stamped seed + decision_sequence
+    replay the identical decisions against the same measurements. The
+    run's ``autotune`` provenance record (verdict consumed, every
+    candidate's values/metrics/gate outcome) appends to the trajectory
+    store exactly like bench_doctor's (CORDA_TPU_TRAJECTORY overrides
+    the path; append is best-effort — a read-only checkout costs the
+    append, never the section)."""
+    import os as _os
+
+    from corda_tpu.autotune import controller as _ctl
+    from corda_tpu.obs import doctor as _doctor
+    from corda_tpu.tools.loadtest import run_ingest_sweep
+
+    sweep = run_ingest_sweep(rates=(rate_tx_s,), n_tx=n_tx, width=1,
+                             workers=workers, max_seconds=240.0)
+    rows = [r for r in sweep.results.values()
+            if isinstance(r, dict) and "error" not in r]
+    if not rows:
+        return {"error": "baseline ingest run failed every rate",
+                "rates": {f"{k:g}_tx_s": v
+                          for k, v in sweep.results.items()}}
+    peak = max(rows, key=lambda r: r.get("achieved_tx_s") or 0.0)
+    baseline_metrics = {
+        "peak_achieved_tx_s": peak.get("achieved_tx_s"),
+        "p99_ms": peak.get("p99_ms"),
+        "exactly_once_all": all(bool(r.get("exactly_once"))
+                                for r in rows),
+    }
+    verdict = sweep.doctor or {}
+    try:
+        spec = _ctl.spec_from_verdict(verdict)
+    except ValueError:
+        # The short baseline abstained (or implicated an un-sweepable
+        # experiment): sweep the default exploratory knobs instead of
+        # producing no section.
+        spec = _ctl.exploratory_spec()
+    runner = _ctl.make_ingest_runner(rates=(rate_tx_s,), n_tx=n_tx,
+                                     workers=workers, max_seconds=240.0)
+    result = _ctl.run_autotune(
+        spec, runner, budget=budget, seed=seed,
+        baseline_metrics=baseline_metrics,
+        verdict_consumed={
+            "source": "bench_autotune_baseline",
+            "first_bottleneck": verdict.get("first_bottleneck"),
+            "experiment_id": spec.experiment_id,
+        })
+    section = {
+        "harness": "multiprocess-driver",
+        "rate_tx_s": rate_tx_s, "n_tx": n_tx, "workers": workers,
+        "seed": seed, "budget": budget,
+        "experiment_id": result["experiment_id"],
+        "cause": result["cause"],
+        "knobs": result["knobs"],
+        "metric": result["metric"],
+        "first_bottleneck": verdict.get("first_bottleneck"),
+        "baseline_value": result["baseline_value"],
+        "best_value": result["best_value"],
+        "improved": result["improved"],
+        "improvement_pct": result["improvement_pct"],
+        "candidates_evaluated": result["candidates_evaluated"],
+        "gate_rejections": result["gate_rejections"],
+        "decision_sequence": result["decision_sequence"],
+        "committed_values": (result["overlay"] or {}).get("values"),
+        "committed_overlay": (result["overlay"] or {}).get("toml"),
+        "candidates": result["candidates"],
+        "doctor": verdict,
+    }
+    record = _doctor.normalize_record(result, source="bench_autotune")
+    path = _os.environ.get("CORDA_TPU_TRAJECTORY") or _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)),
+        "artifacts", "TRAJECTORY.jsonl")
+    section["trajectory"] = {"path": path}
+    try:
+        _doctor.append_trajectory(path, record)
+        section["trajectory"]["appended"] = True
+    except (OSError, ValueError) as e:
+        section["trajectory"]["error"] = f"{type(e).__name__}: {e}"
+        section["trajectory"]["appended"] = False
+    return section
+
+
 def bench_ingest_sweep(rates=(1200.0, 3600.0, 10000.0), n_tx=2000,
                        width=1, workers=3, chaos_rate=1200.0,
                        chaos_n_tx=600, pipeline_rate=2400.0,
@@ -2065,7 +2160,12 @@ def _run_host_only_phases(report: dict,
             ("composite_3of3", lambda: bench_multisig(
                 verifier=CpuVerifier())),
             ("partial_merkle", bench_partial_merkle),
-            ("flow_churn", bench_flow_churn)):
+            ("flow_churn", bench_flow_churn),
+            # The autotune plane's closed loop: a real doctor verdict
+            # over a baseline ingest run steers a gated knob sweep —
+            # pure host path (multiprocess harness, host crypto), so
+            # the host-only run measures the identical section.
+            ("autotune", bench_autotune)):
         set_phase(name)
         try:
             configs[name] = fn()
@@ -2311,7 +2411,11 @@ def _run_phases(report: dict) -> None:
                      ("trader_dvp", bench_trades),
                      ("composite_3of3", bench_multisig),
                      ("partial_merkle", bench_partial_merkle),
-                     ("flow_churn", bench_flow_churn)):
+                     ("flow_churn", bench_flow_churn),
+                     # Autotune closed loop: verdict -> gated knob sweep
+                     # -> committed overlay. Host-path harness on both
+                     # runs (the claim is the LOOP, not kernels).
+                     ("autotune", bench_autotune)):
         set_phase(name)
         try:
             configs[name] = fn()

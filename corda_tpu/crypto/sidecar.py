@@ -798,6 +798,19 @@ class SidecarServer:
             self.coalesce_us = new
             self.coalesce_adjustments += 1
 
+    def reset_window(self) -> None:
+        """Cross-candidate seam (the autotune controller calls this
+        between back-to-back sweep candidates, and the runtime leg's
+        revert guard calls it to undo a bad tune): restore the
+        CONFIGURED coalesce window and zero the adaptation window, so
+        the next candidate's first ADAPT_WINDOW batches are judged on
+        its own traffic, not the previous candidate's adapted state.
+        Cumulative stats (batches/sigs/coalesce_adjustments) survive —
+        this resets the control state, not the audit trail."""
+        with self._lock:
+            self.coalesce_us = self.coalesce_us_initial
+            self._win_batches = self._win_requests = self._win_sigs = 0
+
     # -- stats --------------------------------------------------------------
 
     def stats(self) -> dict:

@@ -181,6 +181,56 @@ class ShardConfig:
     reserve_ttl_s: float = 15.0
 
 
+# One env var carries any number of per-knob config overrides to spawned
+# node processes (autotune sweep candidates, driver env_extra): a JSON
+# object deep-merged over the parsed TOML in NodeConfig.load. Keys may
+# be nested ({"raft": {"pipeline_window": 2048}}) or dotted
+# ("raft.pipeline_window": 2048 — the autotune knob-name spelling);
+# unknown keys still fail from_dict's known-keys validation, so a typo'd
+# overlay crashes the node at boot instead of silently tuning nothing.
+OVERLAY_ENV = "CORDA_TPU_CONFIG_OVERLAY"
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    """A new dict: ``overlay`` wins, nested dicts merge key-wise."""
+    out = dict(base)
+    for key, value in overlay.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _deep_merge(out[key], value)
+        else:
+            out[key] = value
+    return out
+
+
+def config_overlay_from_env(env=None) -> dict:
+    """The parsed, nested overlay from ``OVERLAY_ENV`` (empty dict when
+    unset). Malformed JSON raises — the overlay is machine-written, and
+    a candidate that silently ran defaults would corrupt a sweep."""
+    raw = (env if env is not None else os.environ).get(OVERLAY_ENV, "")
+    if not raw:
+        return {}
+    overlay = json.loads(raw)
+    if not isinstance(overlay, dict):
+        raise ValueError(
+            f"{OVERLAY_ENV} must be a JSON object, got "
+            f"{type(overlay).__name__}")
+    nested: dict = {}
+    for key, value in overlay.items():
+        if "." in key:
+            section, sub = key.split(".", 1)
+            entry = nested.setdefault(section, {})
+            if not isinstance(entry, dict):
+                raise ValueError(
+                    f"{OVERLAY_ENV}: {key!r} conflicts with scalar "
+                    f"{section!r}")
+            entry[sub] = value
+        elif isinstance(value, dict) and isinstance(nested.get(key), dict):
+            nested[key] = _deep_merge(nested[key], value)
+        else:
+            nested[key] = value
+    return nested
+
+
 @dataclass(frozen=True)
 class NodeConfig:
     name: str
@@ -215,10 +265,21 @@ class NodeConfig:
 
     @staticmethod
     def load(path: str | os.PathLike) -> "NodeConfig":
-        """Parse a TOML config file; relative paths resolve against its dir."""
+        """Parse a TOML config file; relative paths resolve against its
+        dir. The ``CORDA_TPU_CONFIG_OVERLAY`` env (a JSON object, set by
+        the autotune controller / testing driver for spawned processes)
+        deep-merges over the parsed TOML before validation, so one env
+        var carries any number of per-knob overrides to every child
+        process. Precedence, lowest to highest: TOML file < overlay <
+        the explicit per-subsystem CORDA_TPU_* env vars read at their
+        use sites (e.g. CORDA_TPU_FEDERATION still outranks an
+        overlay-set [batch] sidecar in _select_batch_verifier)."""
         path = Path(path)
         with open(path, "rb") as f:
             raw = tomllib.load(f)
+        overlay = config_overlay_from_env()
+        if overlay:
+            raw = _deep_merge(raw, overlay)
         return NodeConfig.from_dict(raw, default_dir=path.parent)
 
     @staticmethod

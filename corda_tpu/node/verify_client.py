@@ -104,6 +104,14 @@ class SidecarVerifier(DeviceRoutedVerifier):
         # scheduler can order/flush around it.
         self.qos_hint: tuple[int, int] | None = None
 
+    def reset_window(self) -> None:
+        """Cache-bust seam for back-to-back measurements (the autotune
+        controller calls this between sweep candidates): drop every
+        cached server snapshot so the next stats ride fetches fresh —
+        the 5 s TTL would otherwise hand candidate N the stats of
+        candidate N-1."""
+        self._server_snapshots.clear()
+
     # -- routing ------------------------------------------------------------
 
     def _verify_ed25519(self, jobs: Sequence[VerifyJob]) -> np.ndarray:

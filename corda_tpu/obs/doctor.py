@@ -63,7 +63,9 @@ import re
 __all__ = [
     "DEFAULT_POLICY",
     "MIN_ATTRIBUTION_ROUNDS",
+    "PIPELINED_RULE_SPECS",
     "RULES",
+    "RULE_SPECS",
     "SCHEMA_VERSION",
     "append_trajectory",
     "diagnose",
@@ -72,6 +74,7 @@ __all__ = [
     "load_trajectory",
     "normalize_record",
     "stamp_attribution",
+    "suggest_spec",
     "trajectory_delta",
 ]
 
@@ -212,6 +215,114 @@ def _suggest(cause: str, pipelined: bool = False) -> str:
         if hit:
             return hit
     return RULES.get(cause) or _GENERIC_SUGGESTION.format(cause=cause)
+
+
+# ---------------------------------------------------------------------------
+# Structured rule specs (round 21): the machine-readable twin of each
+# prose rule above — the experiment id, the autotune-registry knob names
+# the experiment sweeps, and the harness that measures it. The autotune
+# controller consumes THESE (never the prose, which stays a human
+# rendering pinned byte-identical by test_perf_doctor.py); every knob
+# name here must resolve in corda_tpu.autotune.space.KNOBS, which
+# validates the cross-reference so the two tables cannot drift apart.
+# An empty knobs tuple means the experiment is not a parameter sweep
+# (profiling, A/B flag flips, operational rebalancing).
+# ---------------------------------------------------------------------------
+
+RULE_SPECS: dict = {
+    "device_occupancy": {
+        "experiment_id": "grow_coalesce_ladder",
+        "knobs": ("sidecar.coalesce_us", "batch.device_min_sigs"),
+        "harness": "slo_sweep"},
+    "pad_fraction": {
+        "experiment_id": "grow_bucket_ladder",
+        "knobs": ("batch.max_sigs", "batch.device_min_sigs"),
+        "harness": "slo_sweep"},
+    "admission": {
+        "experiment_id": "calibrate_admission",
+        "knobs": ("qos.interactive_rate", "qos.bulk_rate",
+                  "qos.queue_watermark"),
+        "harness": "slo_sweep"},
+    "rounds": {
+        "experiment_id": "amortize_round_overhead",
+        # notary_shards.count is the prose remedy's bigger hammer, but
+        # it only applies to raft-* notaries — the ingest harness runs
+        # a simple notary, so the sweepable levers are the accumulation
+        # window and the apply-queue depth.
+        "knobs": ("batch.coalesce_ms", "raft.apply_queue_depth"),
+        "harness": "ingest_sweep"},
+    "seal": {
+        "experiment_id": "raise_group_commit_density",
+        "knobs": ("batch.coalesce_ms", "raft.append_chunk"),
+        "harness": "ingest_sweep"},
+    "replicate": {
+        "experiment_id": "widen_replication_window",
+        "knobs": ("raft.pipeline_window", "raft.append_chunk"),
+        "harness": "ingest_sweep"},
+    "poll": {
+        "experiment_id": "raise_accumulation_window",
+        "knobs": ("batch.coalesce_ms",),
+        "harness": "ingest_sweep"},
+    "verify_wait": {
+        "experiment_id": "deepen_async_verify",
+        "knobs": ("batch.async_depth", "sidecar.coalesce_us"),
+        "harness": "ingest_sweep"},
+    "apply": {
+        "experiment_id": "profile_apply_path",
+        "knobs": ("raft.apply_queue_depth",),
+        "harness": "ingest_sweep"},
+    "reply": {
+        "experiment_id": "profile_reply_path",
+        "knobs": (),
+        "harness": "trace"},
+    "fsync": {
+        "experiment_id": "batch_fsyncs",
+        "knobs": ("batch.coalesce_ms",),
+        "harness": "ingest_sweep"},
+    "verify": {
+        "experiment_id": "raise_device_routing",
+        "knobs": ("sidecar.coalesce_us", "batch.device_min_sigs"),
+        "harness": "slo_sweep"},
+    "election_churn": {
+        "experiment_id": "arm_prevote_ab",
+        "knobs": (),
+        "harness": "partition_chaos"},
+    "host_imbalance": {
+        "experiment_id": "rebalance_federation",
+        "knobs": (),
+        "harness": "federation"},
+}
+
+# Pipelined overlay, mirroring PIPELINED_RULES: once the commit plane
+# overlaps, the same cause implicates the executor levers instead.
+PIPELINED_RULE_SPECS: dict = {
+    "rounds": {
+        "experiment_id": "sweep_executor_levers",
+        "knobs": ("raft.apply_queue_depth",),
+        "harness": "ingest_sweep"},
+    "seal": {
+        "experiment_id": "tune_midround_seal_trigger",
+        "knobs": ("raft.append_chunk",),
+        "harness": "ingest_sweep"},
+    "apply": {
+        "experiment_id": "sweep_apply_queue_depth",
+        "knobs": ("raft.apply_queue_depth",),
+        "harness": "ingest_sweep"},
+}
+
+_GENERIC_SPEC = {"experiment_id": "profile_stage", "knobs": (),
+                 "harness": "trace"}
+
+
+def suggest_spec(cause: str, pipelined: bool = False) -> dict:
+    """The structured spec for a cause — same lookup/fallback order as
+    ``_suggest`` so the machine-readable field on a bottleneck entry
+    always describes the same experiment as its prose twin."""
+    if pipelined:
+        hit = PIPELINED_RULE_SPECS.get(cause)
+        if hit:
+            return dict(hit)
+    return dict(RULE_SPECS.get(cause) or _GENERIC_SPEC)
 
 
 def _finite(value) -> float | None:
@@ -364,7 +475,8 @@ def _candidates(signals: dict) -> list[dict]:
             out.append({"cause": "device_occupancy",
                         "score": round(1.0 - mean_occ, 4),
                         "evidence": evidence,
-                        "next_experiment": _suggest("device_occupancy")})
+                        "next_experiment": _suggest("device_occupancy"),
+                        "experiment": suggest_spec("device_occupancy")})
 
     # Rule: busiest round stage majority across members (the legacy
     # heuristic, kept as one evidence stream among several — each value
@@ -382,7 +494,8 @@ def _candidates(signals: dict) -> list[dict]:
                     "score": round(0.5 + 0.5 * frac, 4),
                     "evidence": {"busiest_stage_by_member_count": counts,
                                  "members_reporting": len(stages)},
-                    "next_experiment": _suggest(top, pipelined)})
+                    "next_experiment": _suggest(top, pipelined),
+                    "experiment": suggest_spec(top, pipelined)})
 
     # Rule: dominant round phase from the merged telemetry profiler
     # breakdown — the block that decomposes a "rounds" wall into
@@ -401,7 +514,8 @@ def _candidates(signals: dict) -> list[dict]:
                                  {p: round(v, 4)
                                   for p, v in sorted(phases.items())},
                                  "rounds": breakdown.get("rounds")},
-                    "next_experiment": _suggest(top, pipelined)})
+                    "next_experiment": _suggest(top, pipelined),
+                    "experiment": suggest_spec(top, pipelined)})
 
     # Rule: high mesh pad fraction -> bucket growth.
     pad = _finite(signals.get("pad_fraction"))
@@ -411,7 +525,8 @@ def _candidates(signals: dict) -> list[dict]:
                     "evidence": {"pad_fraction": round(pad, 4),
                                  "batch_sigs_hist":
                                  signals.get("batch_sigs_hist")},
-                    "next_experiment": _suggest("pad_fraction")})
+                    "next_experiment": _suggest("pad_fraction"),
+                    "experiment": suggest_spec("pad_fraction")})
 
     # Rule: shed-dominated admission -> recalibration.
     adm = signals.get("admission") or {}
@@ -424,7 +539,8 @@ def _candidates(signals: dict) -> list[dict]:
                         "score": round(0.5 + 0.5 * frac, 4),
                         "evidence": {"admitted": admitted, "shed": shed,
                                      "shed_fraction": round(frac, 4)},
-                        "next_experiment": _suggest("admission")})
+                        "next_experiment": _suggest("admission"),
+                        "experiment": suggest_spec("admission")})
 
     # Rule: federation routing-share skew -> host rebalance. Evidence
     # pairs each host's share of routed batches with that host's own
@@ -445,7 +561,8 @@ def _candidates(signals: dict) -> list[dict]:
                                 fed.get("occupancy_by_host"),
                             "dispatches": fed.get("dispatches"),
                             "hedges": fed.get("hedges")},
-                        "next_experiment": _suggest("host_imbalance")})
+                        "next_experiment": _suggest("host_imbalance"),
+                        "experiment": suggest_spec("host_imbalance")})
 
     # Rule: election churn -> prevote/check-quorum hardening. A healthy
     # run elects each group's leader once and keeps it; repeated
@@ -466,7 +583,8 @@ def _candidates(signals: dict) -> list[dict]:
                 "elections_won", "leader_stepdowns",
                 "checkquorum_stepdowns", "prevote_rejections",
                 "max_term", "members", "prevote")},
-            "next_experiment": _suggest("election_churn")})
+            "next_experiment": _suggest("election_churn"),
+            "experiment": suggest_spec("election_churn")})
 
     # Deterministic ranking: score desc, then cause name — two equal
     # scores can't flap the verdict between runs.
@@ -544,6 +662,8 @@ def _classify(artifact: dict) -> str:
     against a multichip capture would be noise)."""
     if not isinstance(artifact, dict):
         return "unknown"
+    if "autotune_schema" in artifact:
+        return "autotune"
     if artifact.get("metric") == "verified_sigs_per_sec" \
             or "baseline_configs" in artifact:
         return "bench_report"
@@ -865,16 +985,74 @@ def _hoist_metrics(artifact: dict, kind: str) -> dict:
                   if "parity_ok" in w]
         if parity:
             m["parity_ok_all"] = all(parity)
+    elif kind == "autotune":
+        # Controller provenance record (autotune/controller.py
+        # run_autotune): the committed config's swept-metric value
+        # against the hand-tuned incumbent, plus search accounting. The
+        # best config's exactly-once verdict rides as the hard flag.
+        put("autotune_best_value", artifact.get("best_value"))
+        put("autotune_baseline_value", artifact.get("baseline_value"))
+        put("autotune_candidates", artifact.get("candidates_evaluated"))
+        put("autotune_gate_rejections", artifact.get("gate_rejections"))
+        put("autotune_improvement_pct", artifact.get("improvement_pct"))
+        best = ((artifact.get("best") or {}).get("metrics") or {})
+        if isinstance(best.get("exactly_once_all"), bool):
+            m["autotune_exactly_once_all"] = best["exactly_once_all"]
     return m
+
+
+def _autotune_provenance(artifact: dict) -> dict:
+    """The autotune record's provenance block: which verdict the loop
+    consumed, every candidate tried (values moved, metrics measured,
+    gate outcome), the decision sequence + seed that replay the search,
+    and what — if anything — was committed."""
+    candidates = []
+    for c in artifact.get("candidates") or []:
+        if not isinstance(c, dict):
+            continue
+        entry = {"id": c.get("id"), "knob": c.get("knob"),
+                 "accepted": bool(c.get("accepted")),
+                 "metrics": c.get("metrics")}
+        if "from" in c:
+            entry["from"] = c["from"]
+            entry["to"] = c.get("to")
+        g = c.get("gate")
+        if isinstance(g, dict):
+            entry["gate_ok"] = bool(g.get("ok"))
+            if g.get("hard_vetoes"):
+                entry["hard_vetoes"] = [h.get("metric")
+                                        for h in g["hard_vetoes"]]
+            if g.get("soft_regressions"):
+                entry["regressions"] = [h.get("metric")
+                                        for h in g["soft_regressions"]]
+        candidates.append(entry)
+    return {
+        "experiment_id": artifact.get("experiment_id"),
+        "cause": artifact.get("cause"),
+        "harness": artifact.get("harness"),
+        "metric": artifact.get("metric"),
+        "seed": artifact.get("seed"),
+        "budget": artifact.get("budget"),
+        "knobs": artifact.get("knobs"),
+        "verdict_consumed": artifact.get("verdict_consumed"),
+        "decision_sequence": artifact.get("decision_sequence"),
+        "candidates": candidates,
+        "committed": bool(artifact.get("committed")),
+        "committed_values": (artifact.get("overlay") or {}).get("values"),
+    }
 
 
 def normalize_record(artifact: dict, source: str = "") -> dict:
     """One schema-versioned trajectory record: the artifact's kind, its
     flat key metrics, and the doctor's verdict over it — everything the
-    gate and the trend tooling need without re-opening the artifact."""
+    gate and the trend tooling need without re-opening the artifact.
+    Autotune records additionally carry the full search provenance
+    (verdict consumed, candidates tried with per-candidate metrics and
+    gate outcomes, the replay seed) — the loop's audit trail lives in
+    the store, not in a side file."""
     kind = _classify(artifact)
     verdict = diagnose(extract_signals(artifact))
-    return {
+    record = {
         "schema": SCHEMA_VERSION,
         "kind": kind,
         "source": os.path.basename(source) if source else "",
@@ -886,6 +1064,9 @@ def normalize_record(artifact: dict, source: str = "") -> dict:
             "gap_factor": verdict["roofline"]["gap_factor"],
         },
     }
+    if kind == "autotune":
+        record["autotune"] = _autotune_provenance(artifact)
+    return record
 
 
 def append_trajectory(path: str, record: dict) -> None:
@@ -966,6 +1147,15 @@ DEFAULT_POLICY: dict = {
     "partition_minority_commits": {"direction": "lower", "pct": 20.0},
     "partition_lost_acks": {"direction": "lower", "pct": 20.0},
     "history_linearizable": {"direction": "equal"},
+    # Autotune plane (round 21): the loop's committed and baseline
+    # swept-metric values are banded a little wider than raw throughput
+    # (25%) — short sweep candidates are noisier than full bench runs —
+    # while the best config's exactly-once verdict is a hard flag: an
+    # autotune round whose winner stops being exactly-once is a
+    # regression regardless of how fast it got.
+    "autotune_best_value": {"direction": "higher", "pct": 25.0},
+    "autotune_baseline_value": {"direction": "higher", "pct": 25.0},
+    "autotune_exactly_once_all": {"direction": "equal"},
 }
 
 

@@ -132,6 +132,12 @@ COUNTER_NAMES = (
     "raft_prevote_rejections_total",
     "raft_checkquorum_stepdowns_total",
     "partition_cuts_total",
+    # Autotune plane (round 21, corda_tpu/autotune/): sweep candidates
+    # measured, candidates the incumbent gate vetoed, and runtime-leg
+    # hard reverts (the revert-on-regression guard firing).
+    "autotune_candidates_total",
+    "autotune_gate_rejections_total",
+    "autotune_reverts_total",
 )
 
 HISTOGRAM_NAMES = (

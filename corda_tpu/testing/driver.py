@@ -25,6 +25,7 @@ Usage:
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -388,10 +389,15 @@ class Driver:
                    wait: bool = True, extra_toml: str = "",
                    device: str = "cpu",
                    env_extra: dict | None = None,
+                   config_overlay: dict | None = None,
                    host: Host | None = None) -> NodeProcess:
         """env_extra: extra environment for the child (e.g.
         CORDA_TPU_FAULT_PLAN=<plan.toml> to arm a chaos plan in that
-        process without touching node.toml)."""
+        process without touching node.toml). config_overlay: per-knob
+        config overrides for THIS child, shipped as one
+        CORDA_TPU_CONFIG_OVERLAY env (JSON) that NodeConfig.load
+        deep-merges over node.toml — the autotune sweep road; precedence
+        is TOML < overlay < explicit CORDA_TPU_* env vars."""
         host = host or self.host
         node_dir = self.base_dir / name
         host.mkdir(node_dir)
@@ -403,6 +409,9 @@ class Driver:
             extra_toml=extra_toml, rpc_users=rpc_users))
 
         env = _node_env(device)
+        if config_overlay:
+            env["CORDA_TPU_CONFIG_OVERLAY"] = json.dumps(
+                config_overlay, sort_keys=True)
         if env_extra:
             env.update({k: str(v) for k, v in env_extra.items()})
         process = host.spawn(

@@ -38,6 +38,9 @@ def _stub_phases(monkeypatch):
                  "bench_doctor",  # unstubbed, this one APPENDS to the
                  # checked-in artifacts/TRAJECTORY.jsonl from every report
                  # test — test pollution in the working tree
+                 "bench_autotune",  # ditto: a real multiprocess baseline
+                 # sweep plus budgeted candidate sweeps, AND it appends an
+                 # autotune record to the checked-in trajectory store
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -110,6 +113,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # The perf-doctor section (round 17) rides the device phase path —
     # the host-only path asserts it separately; schema parity both ways.
     assert report["doctor"] == {"stub": "bench_doctor"}
+    # The autotune loop (round 21) closes the doctor's loop on the device
+    # phase path — the host-only path asserts it separately.
+    assert report["baseline_configs"]["autotune"] == {
+        "stub": "bench_autotune"}
     assert "phase" not in report
 
 
@@ -185,6 +192,10 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
     # The doctor runs LAST on the host-only path too — after the
     # cpu_oracle ceiling it diagnoses against.
     assert report["doctor"] == {"stub": "bench_doctor"}
+    # The autotune loop rides the host-only path too — degraded hosts
+    # still close the verdict -> sweep -> commit loop, same schema.
+    assert report["baseline_configs"]["autotune"] == {
+        "stub": "bench_autotune"}
 
 
 def test_watchdog_during_headline_phase_reports_honest_zero(monkeypatch,
@@ -1078,3 +1089,90 @@ def test_doctor_section_isolates_store_errors(monkeypatch, tmp_path):
     assert out["record"]["kind"] == "bench_report"
     assert out["trajectory"]["appended"] is False
     assert "ValueError" in out["trajectory"]["error"]
+
+
+def _stub_autotune_baseline(monkeypatch, verdict):
+    """Wire bench_autotune to a stubbed baseline sweep (one healthy row
+    whose metrics sit exactly on the mock surface's default point) and
+    the deterministic monotone mock runner — no real clusters."""
+    import types
+
+    from corda_tpu.autotune import controller
+    from corda_tpu.tools import loadtest
+
+    fake = types.SimpleNamespace(
+        results={2400.0: {"achieved_tx_s": 1000.0, "p99_ms": 50.0,
+                          "exactly_once": True}},
+        doctor=verdict, first_bottleneck=verdict.get("first_bottleneck"))
+    monkeypatch.setattr(loadtest, "run_ingest_sweep", lambda **kw: fake)
+    spec = controller.spec_from_verdict(verdict)
+    mock = controller.make_mock_runner(spec, "monotone")
+    monkeypatch.setattr(controller, "make_ingest_runner",
+                        lambda **kw: mock)
+
+
+def test_autotune_section_contract(monkeypatch, tmp_path):
+    """The autotune section's contract (round 21): the loop consumes the
+    baseline run's REAL doctor verdict (structured experiment spec, not
+    prose), evaluates its gated candidates, reports best vs baseline on
+    the swept metric, and appends one ``autotune`` provenance record to
+    the store CORDA_TPU_TRAJECTORY points at."""
+    from corda_tpu.obs import doctor
+
+    verdict = {"first_bottleneck": "seal",
+               "bottlenecks": [{"cause": "seal",
+                                "experiment": doctor.suggest_spec("seal")}]}
+    _stub_autotune_baseline(monkeypatch, verdict)
+    store = tmp_path / "TRAJECTORY.jsonl"
+    monkeypatch.setenv("CORDA_TPU_TRAJECTORY", str(store))
+
+    out = bench.bench_autotune(budget=3, seed=7)
+    json.dumps(out)  # the one-line contract: fully serializable
+    # The sweep came from the verdict's structured experiment, not a
+    # fallback: seal implicates the group-commit density levers.
+    assert out["experiment_id"] == "raise_group_commit_density"
+    assert out["cause"] == "seal"
+    assert out["first_bottleneck"] == "seal"
+    assert out["knobs"] == ["batch.coalesce_ms", "raft.append_chunk"]
+    assert out["candidates_evaluated"] == 3
+    # The monotone surface rewards stepping up: the loop must beat the
+    # hand-tuned default and commit the winner as a TOML overlay.
+    assert out["improved"] is True
+    assert out["best_value"] > out["baseline_value"] == 1000.0
+    assert out["committed_values"]
+    assert "[" in out["committed_overlay"]  # rendered TOML section
+    assert len(out["decision_sequence"]) == 3
+    assert all(s.endswith(("accept", "reject"))
+               for s in out["decision_sequence"])
+    # Provenance landed in the env-pointed store, kind "autotune".
+    assert out["trajectory"]["appended"] is True
+    lines = store.read_text().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["kind"] == "autotune"
+    assert rec["autotune"]["experiment_id"] == "raise_group_commit_density"
+    assert rec["metrics"]["autotune_best_value"] == out["best_value"]
+
+
+def test_autotune_section_isolates_store_errors(monkeypatch, tmp_path):
+    """An unwritable trajectory store costs the append only — the
+    section's sweep results still land (same isolation as the doctor
+    section). Unlike bench_doctor, the autotune append never READS the
+    store, so the failure mode is a write error, not corrupt JSON."""
+    from corda_tpu.obs import doctor
+
+    verdict = {"first_bottleneck": "seal",
+               "bottlenecks": [{"cause": "seal",
+                                "experiment": doctor.suggest_spec("seal")}]}
+    _stub_autotune_baseline(monkeypatch, verdict)
+    blocker = tmp_path / "occupied"
+    blocker.write_text("i am a file, not a directory")
+    monkeypatch.setenv("CORDA_TPU_TRAJECTORY",
+                       str(blocker / "TRAJECTORY.jsonl"))
+
+    out = bench.bench_autotune(budget=2, seed=7)
+    json.dumps(out)
+    assert out["best_value"] >= out["baseline_value"]
+    assert out["candidates_evaluated"] == 2
+    assert out["trajectory"]["appended"] is False
+    assert "Error" in out["trajectory"]["error"]

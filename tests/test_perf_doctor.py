@@ -2,7 +2,7 @@
 
 Three claims, matching the acceptance criteria:
 
-  * backfill over the eight checked-in artifacts reproduces the two
+  * backfill over the nine checked-in artifacts reproduces the two
     known diagnoses — the r05 flagship kernel-gap (sidecar-era
     occupancy bottleneck) and INGEST_r15's ``first_bottleneck =
     "rounds"`` server wall;
@@ -38,13 +38,13 @@ def test_backfill_covers_all_checked_in_artifacts(tmp_path, capsys):
     summary = json.loads(capsys.readouterr().out)
     assert summary["skipped"] == []
     records = doctor.load_trajectory(str(store))
-    assert len(records) == 8
+    assert len(records) == 9
     sources = [r["source"] for r in records]
     # Deterministic chronological order: (round, filename).
     assert sources == sorted(
         sources, key=lambda s: (doctor._round_of({}, s), s))
     assert {r["kind"] for r in records} == {
-        "bench_report", "flagship_capture", "ingest_sweep",
+        "autotune", "bench_report", "flagship_capture", "ingest_sweep",
         "multichip_capture"}
     # Idempotent: a re-run rebuilds the identical store.
     before = store.read_text()
