@@ -113,6 +113,12 @@ KNOBS: dict = {k.name: k for k in (
     Knob("notary_shards.count", "config:notary_shards.count",
          "int", 1, 4, 2.0, "mul", 1,
          ("rounds",)),
+    # Vault engine ([vault]) — a boolean lever walked as 0/1: arming it
+    # swaps the in-memory vault for the sqlite indexed engine when the
+    # doctor's vault_scan rule fires.
+    Knob("vault.indexed", "config:vault.indexed",
+         "int", 0, 1, 1.0, "add", 0,
+         ("vault_scan",)),
 )}
 
 
@@ -251,6 +257,7 @@ def _config_sections() -> dict:
         "qos": _config.QosConfig,
         "durability": _config.DurabilityConfig,
         "notary_shards": _config.ShardConfig,
+        "vault": _config.VaultConfig,
     }
 
 

@@ -126,8 +126,12 @@ class BuyerFlow(FlowLogic):
 
         my_key = self.service_hub.my_identity.owning_key
         tx = TransactionBuilder(notary=self.notary)
-        vault_states = self.service_hub.vault_service.unconsumed_states(
-            CashState)
+        # Soft-locked indexed coin selection: concurrent buyers on this
+        # vault reserve disjoint coins instead of racing generate_spend
+        # over the same full listing and double-spending at the notary.
+        vault_states = self.service_hub.vault_service.select_coins(
+            str(trade.price.token), trade.price.quantity,
+            holder=self.run_id or b"buyer")
         Cash.generate_spend(
             tx, trade.price, trade.seller_owner_key, vault_states,
             change_owner=my_key)

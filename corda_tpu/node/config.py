@@ -165,6 +165,26 @@ class DurabilityConfig:
 
 
 @dataclass(frozen=True)
+class VaultConfig:
+    """Vault engine selection (node/services/vault.py).
+
+    ``indexed = false`` (the default) keeps the in-memory
+    NodeVaultService — bit-identical to the pre-vault-plane tree.
+    ``indexed = true`` (or CORDA_TPU_VAULT_INDEXED=1) arms the sqlite
+    IndexedVaultService: durable vault_states rows with covering
+    indexes, watermark incremental boot, O(1) balance aggregates."""
+
+    indexed: bool = False
+    # Soft-lock reservation TTL for select_coins: how long a selected
+    # coin stays shadowed from other flows before a crashed/abandoned
+    # selection re-admits it.
+    softlock_ttl_s: float = 5.0
+    # Transactions per notify batch during watermark rebuild (bounds
+    # boot memory, never the full ledger at once).
+    rebuild_batch: int = 512
+
+
+@dataclass(frozen=True)
 class ShardConfig:
     """Sharded-notary topology (services/sharding.py).
 
@@ -251,6 +271,7 @@ class NodeConfig:
     raft: RaftConfig = field(default_factory=RaftConfig)
     qos: QosConfig = field(default_factory=QosConfig)
     durability: DurabilityConfig = field(default_factory=DurabilityConfig)
+    vault: VaultConfig = field(default_factory=VaultConfig)
     # Sharded notary: when set (count > 1 or groups non-empty), this raft-*
     # notary member is one shard of a partitioned uniqueness service and
     # uses the ShardedUniquenessProvider two-phase coordinator.
@@ -287,7 +308,7 @@ class NodeConfig:
         base = Path(raw.get("base_dir", default_dir or "."))
         known = {"name", "base_dir", "host", "port", "notary", "raft_cluster",
                  "network_map", "map_service", "map_node", "tls", "web_port",
-                 "verifier", "batch", "raft", "qos", "durability",
+                 "verifier", "batch", "raft", "qos", "durability", "vault",
                  "rpc_users", "cordapps", "notary_shards"}
         unknown = set(raw) - known
         if unknown:
@@ -305,6 +326,7 @@ class NodeConfig:
         raft = raw.get("raft", {})
         qos = raw.get("qos", {})
         durability = raw.get("durability", {})
+        vault = raw.get("vault", {})
         shards_raw = raw.get("notary_shards")
         shards = None
         if shards_raw is not None:
@@ -384,6 +406,11 @@ class NodeConfig:
                     durability.get("scrub_rows_per_s", 500.0)),
                 scrub_interval_s=float(
                     durability.get("scrub_interval_s", 5.0)),
+            ),
+            vault=VaultConfig(
+                indexed=bool(vault.get("indexed", False)),
+                softlock_ttl_s=float(vault.get("softlock_ttl_s", 5.0)),
+                rebuild_batch=int(vault.get("rebuild_batch", 512)),
             ),
             notary_shards=shards,
             rpc_users=tuple(

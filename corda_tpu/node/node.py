@@ -214,6 +214,21 @@ class Node:
         self.identity_service = InMemoryIdentityService()
         self.network_map_cache = InMemoryNetworkMapCache()
         key_service = SimpleKeyManagementService([self.key])
+        # Vault engine selection: [vault] indexed=true or the env var arms
+        # the sqlite-backed IndexedVaultService (durable rows, O(log n)
+        # queries, watermark incremental boot). Unset = the in-memory
+        # engine, bit-identical to before the vault plane existed.
+        self._vault_indexed = bool(config.vault.indexed) or os.environ.get(
+            "CORDA_TPU_VAULT_INDEXED", "") not in ("", "0")
+        if self._vault_indexed:
+            from .services.vault import IndexedVaultService
+
+            vault_service = IndexedVaultService(
+                self.db, lambda: set(key_service.keys.keys()),
+                softlock_ttl_s=config.vault.softlock_ttl_s)
+        else:
+            vault_service = NodeVaultService(
+                lambda: set(key_service.keys.keys()))
         self.services = ServiceHub(
             identity_service=self.identity_service,
             key_management_service=key_service,
@@ -223,8 +238,7 @@ class Node:
                 state_machine_recorded_transaction_mapping=(
                     DBTransactionMappingStorage(self.db)),
             ),
-            vault_service=NodeVaultService(
-                lambda: set(key_service.keys.keys())),
+            vault_service=vault_service,
             network_map_cache=self.network_map_cache,
             clock=Clock(),
             my_info=self.info,
@@ -328,13 +342,26 @@ class Node:
                     queue_watermark=config.qos.queue_watermark)
 
         # -- vault rebuild + scheduler ------------------------------------
-        # The vault is an in-memory projection of durable transaction
-        # storage: replay it so a restarted node sees its unconsumed states
-        # (the reference's vault is DB-backed; same post-restart capability).
-        stored = self.services.storage_service.validated_transactions \
-            .all_transactions()
-        if stored:
-            self.services.vault_service.notify_all(stored)
+        # The vault is a projection of durable transaction storage: rebuild
+        # it so a restarted node sees its unconsumed states (the
+        # reference's vault is DB-backed; same post-restart capability).
+        # Indexed engine: replay only the delta above its persisted
+        # watermark. Legacy engine: stream the whole history through
+        # notify_all in bounded batches — never the full ledger in memory.
+        tx_storage = self.services.storage_service.validated_transactions
+        if self._vault_indexed:
+            self.services.vault_service.rebuild_from(
+                tx_storage, batch=config.vault.rebuild_batch)
+        else:
+            chunk: list = []
+            for _rowid, stx in tx_storage.stream_since(
+                    0, batch=config.vault.rebuild_batch):
+                chunk.append(stx)
+                if len(chunk) >= config.vault.rebuild_batch:
+                    self.services.vault_service.notify_all(chunk)
+                    chunk = []
+            if chunk:
+                self.services.vault_service.notify_all(chunk)
         # Vault updates join the change feed so RPC push subscribers
         # (explorer) stream ledger activity live, alongside flow events
         # (the reference pushes vaultAndUpdates the same way,
@@ -354,12 +381,21 @@ class Node:
             .subscribe(lambda m: self.smm.changes.append(
                 ("tx_recorded", m.run_id, m.tx_id.bytes)))
         from .services.scheduler import NodeSchedulerService
-        from .services.vault_observers import CashBalanceMetricsObserver
+        from .services.vault_observers import (
+            CashBalanceMetricsObserver,
+            IndexedBalanceMetricsObserver,
+        )
 
         self.scheduler = NodeSchedulerService(
             self.smm, self.services.vault_service)
-        CashBalanceMetricsObserver(self.services.vault_service,
-                                   self.smm.metrics)
+        if self._vault_indexed:
+            # The indexed engine already aggregates balances durably;
+            # publish from its table instead of a second scanning tally.
+            IndexedBalanceMetricsObserver(self.services.vault_service,
+                                          self.smm.metrics)
+        else:
+            CashBalanceMetricsObserver(self.services.vault_service,
+                                       self.smm.metrics)
         from .services.schema import SchemaObserver
 
         self.schema = SchemaObserver(self.services.vault_service, self.db)

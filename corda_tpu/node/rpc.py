@@ -139,6 +139,27 @@ class NodeRpcOps:
     def vault_snapshot(self) -> tuple:
         return tuple(self._node.services.vault_service.current_vault.states)
 
+    def vault_page(self, after_txhash: bytes | None = None,
+                   after_index: int = 0, page_size: int = 256) -> tuple:
+        """One keyset page of unconsumed states: (states, cursor) where
+        cursor is (txhash_bytes, index) to pass back as after_* for the
+        next page, or None at the end. The paginated sibling of
+        vault_snapshot — a million-state vault streams page by page
+        instead of one giant frame."""
+        from .services.vault import VaultQuery
+
+        after = None
+        if after_txhash is not None:
+            after = (bytes(after_txhash), int(after_index))
+        page = self._node.services.vault_service.query(
+            VaultQuery(after=after, page_size=int(page_size)))
+        return (page.states, page.next_cursor)
+
+    def vault_balances(self) -> dict:
+        """Per-currency unconsumed totals — an O(1) aggregate read on the
+        indexed engine, never a vault materialization."""
+        return dict(self._node.services.vault_service.balances())
+
     def verified_transaction(self, tx_id: SecureHash):
         return self._node.services.storage_service.validated_transactions \
             .get_transaction(tx_id)

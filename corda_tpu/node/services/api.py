@@ -125,7 +125,14 @@ NO_UPDATE = Vault.Update(frozenset(), frozenset())
 
 class VaultService:
     """Tracks unconsumed states relevant to the node (reference:
-    Services.kt:95-200)."""
+    Services.kt:95-200).
+
+    The query/selection surface (query, iter_unconsumed, select_coins,
+    balances) has in-memory default implementations here so every engine
+    answers the same API; the indexed engine (services/vault.py)
+    overrides them with sqlite pushdowns. Callers should prefer these
+    over materializing current_vault — a million-state vault must never
+    be copied to answer a page or pick coins."""
 
     @property
     def current_vault(self) -> Vault:
@@ -143,6 +150,139 @@ class VaultService:
 
     def states_of_type(self, cls: type) -> list[StateAndRef]:
         return [s for s in self.current_vault.states if isinstance(s.state.data, cls)]
+
+    # -- paginated query surface (engine-shared API) -----------------------
+
+    @property
+    def softlocks(self):
+        """The engine's soft-lock table, created on first selection."""
+        sl = self.__dict__.get("_softlocks")
+        if sl is None:
+            from .vault import SoftLockManager
+
+            sl = self._softlocks = SoftLockManager()
+        return sl
+
+    def iter_unconsumed(self, of_type: type | None = None, batch: int = 512):
+        """Iterate unconsumed states without materializing a snapshot."""
+        for sar in self.current_vault.states:
+            if of_type is None or isinstance(sar.state.data, of_type):
+                yield sar
+
+    def unconsumed_states(self, of_type: type | None = None) -> list:
+        """Compatibility shim: a full typed listing via the iterator."""
+        return list(self.iter_unconsumed(of_type))
+
+    def query(self, q) -> Any:
+        """Answer one VaultQuery page. Default: python-side predicate
+        evaluation over the iterator with the same (ref_txhash,
+        ref_index) keyset order as the indexed engine, so pagination
+        cursors mean the same thing on both."""
+        from ...obs import telemetry as _tm
+        from ...obs import trace as _obs
+        from .vault import (
+            VaultPage,
+            _participant_leaves,
+            _sort_key,
+            coin_of,
+            record_vault_stage,
+        )
+
+        t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
+        _tm.inc("vault_queries_total")
+        after = None
+        if q.after is not None:
+            after = (bytes(q.after[0]), int(q.after[1]))
+        want_leaves = None
+        if q.participant is not None:
+            want_leaves = set(_participant_leaves(q.participant))
+            if not want_leaves:
+                return VaultPage((), None)
+        page = max(1, int(q.page_size))
+        out: list[StateAndRef] = []
+        for sar in sorted(self.iter_unconsumed(q.state_type), key=_sort_key):
+            if after is not None and _sort_key(sar) <= after:
+                continue
+            if (q.currency is not None or q.min_amount is not None
+                    or q.max_amount is not None):
+                currency, amount = coin_of(sar.state.data)
+                if q.currency is not None and currency != q.currency:
+                    continue
+                if q.min_amount is not None and (
+                        amount is None or amount < q.min_amount):
+                    continue
+                if q.max_amount is not None and (
+                        amount is None or amount > q.max_amount):
+                    continue
+            if want_leaves is not None and not any(
+                    set(_participant_leaves(p)) & want_leaves
+                    for p in sar.state.data.participants):
+                continue
+            out.append(sar)
+            if len(out) > page:
+                break
+        more = len(out) > page
+        out = out[:page]
+        next_cursor = _sort_key(out[-1]) if more and out else None
+        record_vault_stage(t0, attrs={"rows": len(out), "op": "query"})
+        return VaultPage(tuple(out), next_cursor)
+
+    def select_coins(self, currency: str, quantity: int,
+                     holder: bytes = b"", ttl_s: float | None = None) -> list:
+        """Soft-locked coin selection, largest-first. Default engine:
+        scan + sort candidates in memory; same reservation semantics and
+        amount-DESC order as the indexed engine's index walk. On
+        insufficient funds the partial set is returned unlocked (the
+        asset's generate_spend raises InsufficientBalanceException)."""
+        from ...obs import telemetry as _tm
+        from ...obs import trace as _obs
+        from .vault import _sort_key, coin_of, record_vault_stage
+
+        t0 = _obs.now() if _obs.ACTIVE is not None else 0.0
+        _tm.inc("vault_queries_total")
+        locks = self.softlocks
+        expired = locks.sweep()
+        if expired:
+            _tm.inc("vault_softlock_expired_total", expired)
+        holder = bytes(holder) or b"anon"
+        candidates = []
+        for sar in self.iter_unconsumed():
+            c, amount = coin_of(sar.state.data)
+            if c == currency:
+                candidates.append((-amount, _sort_key(sar), sar))
+        candidates.sort(key=lambda t: t[:2])
+        gathered: list[StateAndRef] = []
+        covered = 0
+        for neg_amount, _key, sar in candidates:
+            if not locks.try_lock(sar.ref, holder, ttl_s):
+                _tm.inc("vault_selection_conflicts_total")
+                continue
+            gathered.append(sar)
+            covered += -neg_amount
+            if covered >= quantity:
+                break
+        if covered < quantity:
+            locks.release([sar.ref for sar in gathered], holder)
+        record_vault_stage(t0, attrs={"rows": len(gathered), "op": "select"})
+        return gathered
+
+    def release_coins(self, refs: Iterable[StateRef],
+                      holder: bytes = b"") -> None:
+        """Drop this holder's reservations (a flow that selected but
+        will not spend must give its coins back before the TTL)."""
+        self.softlocks.release(refs, bytes(holder) or b"anon")
+
+    def balances(self) -> dict[str, int]:
+        """Per-currency unconsumed totals. Default: one pass over the
+        iterator; the indexed engine answers from its aggregate table."""
+        from .vault import coin_of
+
+        out: dict[str, int] = {}
+        for sar in self.iter_unconsumed():
+            currency, amount = coin_of(sar.state.data)
+            if currency is not None:
+                out[currency] = out.get(currency, 0) + amount
+        return {c: q for c, q in out.items() if q != 0}
 
 
 # ---------------------------------------------------------------------------

@@ -173,6 +173,12 @@ class NodeVaultService(VaultService):
     def __init__(self, our_keys: Callable[[], set[PublicKey]]):
         self._our_keys = our_keys
         self._unconsumed: dict[StateRef, StateAndRef] = {}
+        # Per-concrete-type secondary index: typed queries (the
+        # coin-selection entry point) stop copying + isinstance-filtering
+        # the whole vault. Each inner dict shares the outer insertion
+        # order, so a single-type lookup returns exactly the subsequence
+        # the old full scan produced.
+        self._by_type: dict[type, dict[StateRef, StateAndRef]] = {}
         self._observers: list[Callable[[Vault.Update], None]] = []
 
     @property
@@ -182,10 +188,24 @@ class NodeVaultService(VaultService):
     def unconsumed_states(self, of_type: type | None = None) -> list:
         """Typed vault query (reference: VaultService statesOfType — the
         coin-selection entry point)."""
-        out = list(self._unconsumed.values())
-        if of_type is not None:
-            out = [s for s in out if isinstance(s.state.data, of_type)]
-        return out
+        return list(self.iter_unconsumed(of_type))
+
+    def iter_unconsumed(self, of_type: type | None = None, batch: int = 512):
+        if of_type is None:
+            yield from self._unconsumed.values()
+            return
+        matching = [stored for stored in self._by_type
+                    if issubclass(stored, of_type)]
+        if len(matching) == 1:
+            yield from self._by_type[matching[0]].values()
+        elif matching:
+            # Several stored concrete types satisfy the query (an
+            # interface/base-class lookup): fall back to the ordered
+            # global scan so interleaving matches the pre-index listing
+            # exactly.
+            for sar in self._unconsumed.values():
+                if isinstance(sar.state.data, of_type):
+                    yield sar
 
     def _is_relevant(self, state) -> bool:
         ours = self._our_keys()
@@ -210,8 +230,18 @@ class NodeVaultService(VaultService):
                 continue
             for sar in consumed:
                 del self._unconsumed[sar.ref]
+                bucket = self._by_type.get(type(sar.state.data))
+                if bucket is not None:
+                    bucket.pop(sar.ref, None)
+                    if not bucket:
+                        del self._by_type[type(sar.state.data)]
             for sar in produced:
                 self._unconsumed[sar.ref] = sar
+                self._by_type.setdefault(type(sar.state.data),
+                                         {})[sar.ref] = sar
+            locks = self.__dict__.get("_softlocks")
+            if locks is not None:
+                locks.release([sar.ref for sar in consumed])
             net = update if net is None else net + update
             for obs in list(self._observers):
                 obs(update)

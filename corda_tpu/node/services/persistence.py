@@ -351,6 +351,22 @@ class DBTransactionStorage(TransactionStorage):
             "SELECT blob FROM transactions ORDER BY rowid").fetchall()
         return [deserialize(bytes(r[0])) for r in rows]
 
+    def stream_since(self, after_rowid: int = 0, batch: int = 512):
+        """Yield (rowid, stx) for transactions stored after ``after_rowid``,
+        fetched in bounded keyset pages — the vault-rebuild path that never
+        materializes the ledger (a million-tx history streams through
+        ``batch`` rows of memory at a time)."""
+        cursor = int(after_rowid)
+        while True:
+            rows = self._db.conn.execute(
+                "SELECT rowid, blob FROM transactions WHERE rowid > ? "
+                "ORDER BY rowid LIMIT ?", (cursor, int(batch))).fetchall()
+            if not rows:
+                return
+            for rowid, blob in rows:
+                yield int(rowid), deserialize(bytes(blob))
+            cursor = int(rows[-1][0])
+
     def subscribe(self, observer: Callable) -> None:
         self._observers.append(observer)
 

@@ -38,8 +38,9 @@ class NodeSchedulerService:
         self._smm = smm
         self._scheduled: dict[StateRef, ScheduledActivity] = {}
         vault_service.subscribe(self._on_vault_update)
-        # Startup: scan current vault for schedulable states.
-        for sar in vault_service.current_vault.states:
+        # Startup: scan the vault for schedulable states — through the
+        # paginated iterator, never a full snapshot copy.
+        for sar in vault_service.iter_unconsumed():
             self._consider(sar)
 
     def _on_vault_update(self, update) -> None:

@@ -41,7 +41,7 @@ class SchemaObserver:
         self._tables: set[str] = set()
         vault_service.subscribe(self._on_update)
         with self._db.lock:
-            for sar in vault_service.current_vault.states:
+            for sar in vault_service.iter_unconsumed():
                 self._produce(sar)
             self._db.conn.commit()
 

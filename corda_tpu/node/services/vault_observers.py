@@ -16,7 +16,7 @@ class CashBalanceMetricsObserver:
         self._metrics = metrics
         self._balances: dict[str, int] = {}
         vault_service.subscribe(self._on_update)
-        for sar in vault_service.current_vault.states:
+        for sar in vault_service.iter_unconsumed():
             self._apply(sar, +1)
         self._publish()
 
@@ -40,3 +40,29 @@ class CashBalanceMetricsObserver:
     def _publish(self) -> None:
         for currency, quantity in self._balances.items():
             self._metrics[f"balance.{currency}"] = quantity
+
+
+class IndexedBalanceMetricsObserver:
+    """The indexed-engine twin: the vault already maintains per-currency
+    aggregates in its vault_balances table, so publishing is one O(1)
+    read of vault.balances() per update — no second in-memory tally that
+    could drift from the durable one. Currencies that drain to zero keep
+    publishing 0 (balances() omits them; the gauge must not go stale)."""
+
+    def __init__(self, vault_service, metrics: dict):
+        self._vault = vault_service
+        self._metrics = metrics
+        self._seen: set[str] = set()
+        vault_service.subscribe(self._on_update)
+        self._publish()
+
+    def _on_update(self, update) -> None:
+        self._publish()
+
+    def _publish(self) -> None:
+        balances = self._vault.balances()
+        for currency in self._seen - set(balances):
+            self._metrics[f"balance.{currency}"] = 0
+        for currency, quantity in balances.items():
+            self._metrics[f"balance.{currency}"] = quantity
+            self._seen.add(currency)

@@ -109,6 +109,10 @@ _HOST_IMBALANCE_SKEW = 0.25
 # partition flap, starved heartbeats, a rejoiner spinning terms — blows
 # straight past it.
 _ELECTION_CHURN_MIN = 5
+# A flow stage (trace stage_breakdown) must claim at least this share of
+# end-to-end flow wall time to nominate a cause — vault_query at/above
+# it means coin selection/queries are scanning, not indexing.
+_FLOW_STAGE_DOMINANT_SHARE = 0.25
 
 # ---------------------------------------------------------------------------
 # The rule table: cause -> the suggested next experiment. Causes either
@@ -179,6 +183,13 @@ RULES: dict = {
         "re-dispatches, then rebalance the routing (drain/readmit the "
         "slow host) or raise CORDA_TPU_FEDERATION_HEDGE_MS so hedges "
         "stop amplifying the skew"),
+    "vault_scan": (
+        "vault queries dominate flow wall time — coin selection is "
+        "scanning a vault that has outgrown the in-memory engine: arm "
+        "[vault] indexed=true (sqlite IndexedVaultService — O(log n) "
+        "covering-index queries, amount-ordered soft-locked coin "
+        "selection, watermark incremental boot) and re-run; the "
+        "vault_scaling bench section proves the crossover"),
 }
 
 _GENERIC_SUGGESTION = (
@@ -291,6 +302,10 @@ RULE_SPECS: dict = {
         "experiment_id": "rebalance_federation",
         "knobs": (),
         "harness": "federation"},
+    "vault_scan": {
+        "experiment_id": "arm_indexed_vault",
+        "knobs": ("vault.indexed",),
+        "harness": "ingest_sweep"},
 }
 
 # Pipelined overlay, mirroring PIPELINED_RULES: once the commit plane
@@ -586,6 +601,22 @@ def _candidates(signals: dict) -> list[dict]:
             "next_experiment": _suggest("election_churn"),
             "experiment": suggest_spec("election_churn")})
 
+    # Rule: vault queries dominating flow wall time -> arm the indexed
+    # vault engine. The shares come from the flagship trace breakdown
+    # (stage mean over end-to-end mean); extraction already abstained
+    # below MIN_ATTRIBUTION_ROUNDS traces, so a share here is evidence.
+    shares = signals.get("flow_stage_shares") or {}
+    vshare = _finite(shares.get("vault_query"))
+    if vshare is not None and vshare >= _FLOW_STAGE_DOMINANT_SHARE:
+        out.append({
+            "cause": "vault_scan",
+            "score": round(0.5 + 0.5 * min(1.0, vshare), 4),
+            "evidence": {"flow_stage_shares":
+                         {k: round(v, 4)
+                          for k, v in sorted(shares.items())}},
+            "next_experiment": _suggest("vault_scan"),
+            "experiment": suggest_spec("vault_scan")})
+
     # Deterministic ranking: score desc, then cause name — two equal
     # scores can't flap the verdict between runs.
     out.sort(key=lambda c: (-c["score"], c["cause"]))
@@ -744,6 +775,25 @@ def extract_signals(artifact: dict) -> dict:
         occ = _finite(flagship.get("device_occupancy"))
         if occ is not None and not stamps:
             signals["device_occupancy_by_member"] = {"flagship": occ}
+
+    # Per-stage share of flow wall time from the flagship trace
+    # breakdown: stage mean over end-to-end mean. Abstains below
+    # MIN_ATTRIBUTION_ROUNDS traces — a handful of flows is noise, not
+    # an attribution.
+    breakdown = ((artifact.get("baseline_configs") or {})
+                 .get("raft_open_loop_latency") or {}).get("stage_breakdown")
+    if isinstance(breakdown, dict):
+        e2e_mean = _finite((breakdown.get("end_to_end") or {})
+                           .get("mean_ms"))
+        traces = _finite(breakdown.get("traces")) or 0
+        if e2e_mean and traces >= MIN_ATTRIBUTION_ROUNDS:
+            shares = {}
+            for stage, entry in (breakdown.get("stages") or {}).items():
+                mean = _finite((entry or {}).get("mean_ms"))
+                if mean is not None:
+                    shares[stage] = min(1.0, mean / e2e_mean)
+            if shares:
+                signals["flow_stage_shares"] = shares
 
     if kind == "ingest_sweep":
         stamps = _member_stamps_of(artifact)
@@ -934,6 +984,14 @@ def _hoist_metrics(artifact: dict, kind: str) -> dict:
         if isinstance(multi, dict):
             put("multichip_scaling_1_to_max",
                 multi.get("scaling_1_to_max"))
+        vault = configs.get("vault_scaling")
+        if isinstance(vault, dict) and "error" not in vault:
+            put("vault_coin_selection_p99_ratio",
+                vault.get("vault_coin_selection_p99_ratio"))
+            put("vault_boot_speedup", vault.get("vault_boot_speedup"))
+            put("vault_query_p99_ms", vault.get("vault_query_p99_ms"))
+            if isinstance(vault.get("vault_parity_ok"), bool):
+                m["vault_parity_ok"] = vault["vault_parity_ok"]
         chaos = artifact.get("chaos")
         if isinstance(chaos, dict):
             put("leader_kill_recovery_s",
@@ -1156,6 +1214,18 @@ DEFAULT_POLICY: dict = {
     "autotune_best_value": {"direction": "higher", "pct": 25.0},
     "autotune_baseline_value": {"direction": "higher", "pct": 25.0},
     "autotune_exactly_once_all": {"direction": "equal"},
+    # Vault scaling (round 22): the coin-selection p99 ratio
+    # (largest-store p99 over smallest-store p99) is the sublinearity
+    # headline — it growing means indexed selection degraded toward a
+    # scan; the boot speedup (full replay over incremental rebuild) is
+    # the watermark win; query p99 is banded like the autotune sweeps
+    # (25%, short in-process runs are noisy); engine parity is a hard
+    # flag — the two engines disagreeing on the unconsumed set is a
+    # correctness regression regardless of speed.
+    "vault_coin_selection_p99_ratio": {"direction": "lower", "pct": 25.0},
+    "vault_boot_speedup": {"direction": "higher", "pct": 25.0},
+    "vault_query_p99_ms": {"direction": "lower", "pct": 25.0},
+    "vault_parity_ok": {"direction": "equal"},
 }
 
 

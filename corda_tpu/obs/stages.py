@@ -57,9 +57,13 @@ BATCH_STAGES = ("queue_wait", "device_verify", "federation_route",
 # integrity-framed tables (node/services/integrity.py); repair is one
 # self-healing action — a raft-log truncate/compact or a checkpoint
 # quarantine (raft._heal_corrupt_entry, persistence.quarantine).
+# vault_query is one vault read — a VaultQuery page or a select_coins
+# walk (node/services/vault.py, attrs["op"] names which); when it
+# dominates a flow's breakdown the doctor's vault_scan rule suggests
+# arming the indexed engine.
 DIRECT_STAGES = ("verify_wait", "admission_wait", "epoch_wait",
                  "lane_queue_wait", "shard_reserve", "shard_commit",
-                 "scrub", "repair")
+                 "scrub", "repair", "vault_query")
 
 # Derived by stage_breakdown, never recorded: the reply tail is
 # root_end - max(attributed stage end).
@@ -67,7 +71,7 @@ DERIVED_STAGES = ("reply",)
 
 # Full breakdown order the bench report presents.
 STAGES = ("admission_wait", "epoch_wait", "queue_wait", "lane_queue_wait",
-          "verify_wait",
+          "vault_query", "verify_wait",
           "device_verify", "federation_route", "remote_verify",
           "sidecar_wait", "sidecar_verify",
           "shard_reserve", "shard_commit",

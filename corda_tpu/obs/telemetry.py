@@ -138,6 +138,13 @@ COUNTER_NAMES = (
     "autotune_candidates_total",
     "autotune_gate_rejections_total",
     "autotune_reverts_total",
+    # Indexed vault plane (round 22, node/services/vault.py): queries
+    # answered (pages + coin selections), coins skipped because another
+    # flow's soft lock held them, and expired reservations reaped by the
+    # TTL sweep (each reap re-admits a coin a crashed flow had shadowed).
+    "vault_queries_total",
+    "vault_selection_conflicts_total",
+    "vault_softlock_expired_total",
 )
 
 HISTOGRAM_NAMES = (

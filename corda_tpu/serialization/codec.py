@@ -115,6 +115,21 @@ def register(cls: Type[T]) -> Type[T]:
     return register_class(cls)
 
 
+def wire_name_of(cls: type) -> str | None:
+    """The registered wire name of a class, None when unregistered.
+
+    Public read-side of the registry for stores that index rows by state
+    type (the vault's state_type pushdown column): the wire name is the
+    one type identifier that is stable across processes and refactors,
+    unlike __qualname__ paths."""
+    return _BY_TYPE.get(cls)
+
+
+def class_for_wire_name(name: str) -> type | None:
+    """The class registered under a wire name, None when unknown."""
+    return _BY_NAME.get(name)
+
+
 # Resolved lazily on the first object encode (.tokens imports this module,
 # so a top-level import would be circular); a per-call `from .tokens import`
 # in the encode hot path showed up in profiles at firehose load.

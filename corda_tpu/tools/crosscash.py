@@ -100,7 +100,12 @@ class CashCommandFlow(FlowLogic):
                     recipient.owning_key, notary, nonce=self.nonce)
             elif self.kind == "pay":
                 tx = TransactionBuilder(notary=notary)
-                states = hub.vault_service.unconsumed_states(CashState)
+                # Soft-locked selection: concurrent pay commands on one
+                # node reserve disjoint coins (the chaos harness runs
+                # several at once against a shared vault).
+                states = hub.vault_service.select_coins(
+                    str(CURRENCY), self.quantity,
+                    holder=self.run_id or b"crosscash")
                 Cash.generate_spend(
                     tx, Amount(self.quantity, CURRENCY),
                     recipient.owning_key, states,

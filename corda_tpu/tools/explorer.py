@@ -152,12 +152,29 @@ class ExplorerModel:
             cursor=self._cursor)
         self._subscribed_at = now
 
+    MAX_VAULT_PAGES = 64  # dashboard view bound: 64 pages × 256 states
+
+    def _gather_vault(self, rpc) -> tuple:
+        """The unconsumed set via keyset-paginated vault_page calls —
+        bounded frames instead of one vault_snapshot that grows with the
+        ledger (and a page cap: a dashboard never needs a million rows)."""
+        states: list = []
+        cursor = (None, 0)
+        for _ in range(self.MAX_VAULT_PAGES):
+            page, cursor = rpc.call(
+                "vault_page", cursor[0], cursor[1], 256)
+            states.extend(page)
+            if cursor is None:
+                break
+        return tuple(states)
+
     def gather(self) -> dict:
         rpc = self.rpc
         self._ensure_subscribed()
         identity = rpc.call("node_identity")
         network = rpc.call("network_map_snapshot")
-        vault = rpc.call("vault_snapshot")
+        vault = self._gather_vault(rpc)
+        balances = rpc.call("vault_balances")
         in_flight = rpc.call("state_machines_snapshot")
         metrics = rpc.call("node_metrics")
         rpc.poll_push()  # drain any pushed frames not seen during calls
@@ -203,7 +220,7 @@ class ExplorerModel:
         return {
             "identity": render_value(identity),
             "network": render_value(network),
-            "balances": cash_balances(vault),
+            "balances": {str(c): int(q) for c, q in balances.items()},
             "vault": render_value(vault),
             "transactions": render_value(transactions),
             "tx_provenance": dict(self._provenance),
@@ -344,12 +361,8 @@ class DemoTraffic:
             keys.fresh_key().public.composite for _ in range(3)]
 
         def issued() -> int:
-            from ..finance import CashState
-
-            return sum(
-                s.state.data.amount.quantity
-                for s in node.services.vault_service.unconsumed_states(
-                    CashState))
+            # O(#currencies) aggregate instead of a per-tick vault scan.
+            return sum(node.services.vault_service.balances().values())
 
         self._gen = cash_event_generator(owners, issued)
         self._cash = Cash
@@ -382,14 +395,21 @@ class DemoTraffic:
             builder.sign_with(node.key)
             node.services.record_transactions([builder.to_signed_transaction()])
         elif isinstance(event, (self._move_cls, self._exit_cls)):
-            states = node.services.vault_service.unconsumed_states(CashState)
-            if not states:
-                return
             builder = TransactionBuilder(notary=node.identity)
             if isinstance(event, self._move_cls):
+                # Indexed soft-locked selection instead of a vault scan.
+                states = node.services.vault_service.select_coins(
+                    str(event.amount.token), event.amount.quantity,
+                    holder=b"explorer-demo")
+                if not states:
+                    return
                 signers = self._cash.generate_spend(
                     builder, event.amount, event.new_owner, states)
             else:
+                states = node.services.vault_service.unconsumed_states(
+                    CashState)
+                if not states:
+                    return
                 # Exit burns an exact issued token: pick one and clamp.
                 from ..finance import Amount
 

@@ -41,6 +41,8 @@ def _stub_phases(monkeypatch):
                  "bench_autotune",  # ditto: a real multiprocess baseline
                  # sweep plus budgeted candidate sweeps, AND it appends an
                  # autotune record to the checked-in trajectory store
+                 "bench_vault_scaling",  # ditto: seeds 100k+-row sqlite
+                 # vaults and replays a 100k-tx boot leg in-process
                  "bench_resolve_ids", "bench_trades", "bench_multisig",
                  "bench_partial_merkle", "bench_flow_churn"):
         monkeypatch.setattr(bench, name,
@@ -117,6 +119,10 @@ def test_report_is_one_json_line(monkeypatch, capsys):
     # phase path — the host-only path asserts it separately.
     assert report["baseline_configs"]["autotune"] == {
         "stub": "bench_autotune"}
+    # The indexed vault plane (round 22) rides the device phase path at
+    # full size spread — the host-only path asserts it separately.
+    assert report["baseline_configs"]["vault_scaling"] == {
+        "stub": "bench_vault_scaling"}
     assert "phase" not in report
 
 
@@ -196,6 +202,10 @@ def test_degraded_mode_measures_host_configs(monkeypatch, capsys):
     # still close the verdict -> sweep -> commit loop, same schema.
     assert report["baseline_configs"]["autotune"] == {
         "stub": "bench_autotune"}
+    # The indexed vault plane rides the host-only path at trimmed sizes
+    # — same schema both ways, so trend tooling greps one key.
+    assert report["baseline_configs"]["vault_scaling"] == {
+        "stub": "bench_vault_scaling"}
 
 
 def test_watchdog_during_headline_phase_reports_honest_zero(monkeypatch,

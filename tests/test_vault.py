@@ -1,0 +1,592 @@
+"""Indexed vault plane (round 22): engine parity, soft-locked coin
+selection, keyset pagination stability, watermark incremental boot, and
+the doctor/gate/autotune plumbing that steers operators onto it.
+
+The two engines — in-memory NodeVaultService and sqlite
+IndexedVaultService — must answer the same notify/query/select surface
+identically; these tests pin that contract from both sides of the
+``[vault] indexed`` switch.
+"""
+
+import threading
+import time
+
+from corda_tpu.contracts.structures import (
+    Issued,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+)
+from corda_tpu.crypto.hashes import SecureHash
+from corda_tpu.crypto.party import PartyAndReference
+from corda_tpu.finance.amount import Amount
+from corda_tpu.finance.cash import CashState
+from corda_tpu.node.config import NodeConfig, VaultConfig
+from corda_tpu.node.services.inmemory import NodeVaultService
+from corda_tpu.node.services.persistence import NodeDatabase
+from corda_tpu.node.services.vault import (
+    IndexedVaultService,
+    SoftLockManager,
+    VaultQuery,
+    seed_states,
+)
+from corda_tpu.obs import doctor
+from corda_tpu.obs import telemetry as _tm
+from corda_tpu.serialization.codec import serialize
+from corda_tpu.testing.identities import ALICE, BOB, DUMMY_NOTARY, MEGA_CORP
+from corda_tpu.utils.bytes import OpaqueBytes
+
+USD = Issued(PartyAndReference(MEGA_CORP, OpaqueBytes(b"\x01")), "USD")
+EUR = Issued(PartyAndReference(MEGA_CORP, OpaqueBytes(b"\x01")), "EUR")
+
+
+def _our_keys():
+    return set(ALICE.owning_key.keys) | set(BOB.owning_key.keys)
+
+
+def _tx_hash(i: int) -> SecureHash:
+    return SecureHash(i.to_bytes(16, "big") + b"vault-test-pad!!")
+
+
+def _cash(qty: int, token=USD, owner=None) -> TransactionState:
+    return TransactionState(
+        CashState(Amount(qty, token), owner or ALICE.owning_key),
+        DUMMY_NOTARY)
+
+
+class _SeedTx:
+    """Signed-tx shim: .tx/.id/inputs/outputs/out_ref — everything
+    notify_all touches, none of the signing/Merkle machinery."""
+
+    __slots__ = ("id", "inputs", "outputs")
+
+    def __init__(self, id, outputs, inputs=()):
+        self.id = id
+        self.outputs = tuple(outputs)
+        self.inputs = tuple(inputs)
+
+    @property
+    def tx(self):
+        return self
+
+    def out_ref(self, i):
+        return StateAndRef(self.outputs[i], StateRef(self.id, i))
+
+
+class _SeedStorage:
+    """stream_since twin over an in-memory tx list whose position
+    mirrors the transactions-table rowid (rows inserted in order)."""
+
+    def __init__(self, txs):
+        self._txs = list(txs)
+
+    def stream_since(self, after_rowid=0, batch=512):
+        start = int(after_rowid)
+        for i, stx in enumerate(self._txs[start:], start=start + 1):
+            yield i, stx
+
+
+def _indexed(tmp_path, name="vault.db", **kw):
+    db = NodeDatabase(tmp_path / name)
+    return db, IndexedVaultService(db, _our_keys, **kw)
+
+
+def _snapshot(engine):
+    return sorted(
+        (s.ref.txhash.bytes, s.ref.index, serialize(s.state).bytes)
+        for s in engine.iter_unconsumed())
+
+
+def _issue_stream(n, qty=lambda i: 100 + i):
+    return [_SeedTx(_tx_hash(i), (_cash(qty(i)),)) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Engine parity
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_identical_unconsumed_set_after_issue_and_spend(self, tmp_path):
+        issues = _issue_stream(40)
+        spends = [
+            _SeedTx(_tx_hash(100 + k), (_cash(7 + k),),
+                    inputs=(StateRef(_tx_hash(i), 0),))
+            for k, i in enumerate(range(0, 40, 3))]
+        mem = NodeVaultService(_our_keys)
+        db, idx = _indexed(tmp_path)
+        for engine in (mem, idx):
+            engine.notify_all(issues)
+            engine.notify_all(spends)
+        assert _snapshot(mem) == _snapshot(idx)
+        assert mem.balances() == idx.balances()
+        db.close()
+
+    def test_query_pushdowns_agree(self, tmp_path):
+        txs = [_SeedTx(_tx_hash(i), (
+            _cash(100 + i, USD if i % 2 else EUR,
+                  ALICE.owning_key if i % 3 else BOB.owning_key),))
+            for i in range(30)]
+        mem = NodeVaultService(_our_keys)
+        db, idx = _indexed(tmp_path)
+        for engine in (mem, idx):
+            engine.notify_all(txs)
+        for q in (VaultQuery(currency="USD"),
+                  VaultQuery(currency="EUR", min_amount=110),
+                  VaultQuery(min_amount=105, max_amount=120),
+                  VaultQuery(participant=BOB.owning_key),
+                  VaultQuery(state_type=CashState)):
+            a = [s.ref for s in mem.query(q).states]
+            b = [s.ref for s in idx.query(q).states]
+            assert a == b, q
+        db.close()
+
+    def test_pagination_cursors_mean_the_same_thing(self, tmp_path):
+        txs = _issue_stream(25)
+        mem = NodeVaultService(_our_keys)
+        db, idx = _indexed(tmp_path)
+        for engine in (mem, idx):
+            engine.notify_all(txs)
+
+        def walk(engine):
+            cursor, refs, pages = None, [], 0
+            while True:
+                page = engine.query(VaultQuery(after=cursor, page_size=7))
+                refs.extend(s.ref for s in page.states)
+                pages += 1
+                cursor = page.next_cursor
+                if cursor is None:
+                    return refs, pages
+
+        a, pa = walk(mem)
+        b, pb = walk(idx)
+        assert a == b and len(a) == 25
+        assert pa == pb == 4
+        db.close()
+
+    def test_coin_selection_picks_same_coins(self, tmp_path):
+        txs = _issue_stream(10, qty=lambda i: 50 * (i + 1))
+        mem = NodeVaultService(_our_keys)
+        db, idx = _indexed(tmp_path)
+        for engine in (mem, idx):
+            engine.notify_all(txs)
+        a = [s.ref for s in mem.select_coins("USD", 900, holder=b"a")]
+        b = [s.ref for s in idx.select_coins("USD", 900, holder=b"a")]
+        assert a == b and a  # largest-first on both engines
+        db.close()
+
+    def test_unconsumed_states_shim_matches_current_vault(self, tmp_path):
+        db, idx = _indexed(tmp_path)
+        idx.notify_all(_issue_stream(5))
+        assert [s.ref for s in idx.unconsumed_states()] == \
+            [s.ref for s in idx.current_vault.states]
+        assert [s.ref for s in idx.unconsumed_states(CashState)] == \
+            [s.ref for s in idx.unconsumed_states()]
+        assert len(idx) == 5
+        db.close()
+
+
+def test_inmemory_typed_index_matches_global_scan_order():
+    """The per-type secondary index must return the exact subsequence
+    the old isinstance full scan produced."""
+    mem = NodeVaultService(_our_keys)
+    mem.notify_all(_issue_stream(12))
+    by_index = [s.ref for s in mem.iter_unconsumed(CashState)]
+    by_scan = [s.ref for s in mem.current_vault.states
+               if isinstance(s.state.data, CashState)]
+    assert by_index == by_scan
+    # Consumption maintains the bucket.
+    mem.notify_all([_SeedTx(_tx_hash(50), (),
+                            inputs=(StateRef(_tx_hash(0), 0),))])
+    assert len(list(mem.iter_unconsumed(CashState))) == 11
+
+
+# ---------------------------------------------------------------------------
+# Soft-locked coin selection
+# ---------------------------------------------------------------------------
+
+
+class TestSoftLocks:
+    def test_one_coin_exactly_one_winner(self, tmp_path):
+        _tm.arm()
+        db, idx = _indexed(tmp_path)
+        idx.notify_all([_SeedTx(_tx_hash(0), (_cash(100),))])
+        a = idx.select_coins("USD", 100, holder=b"flow-a")
+        b = idx.select_coins("USD", 100, holder=b"flow-b")
+        assert len(a) == 1 and b == []
+        assert _tm.ACTIVE.counter(
+            "vault_selection_conflicts_total").value >= 1
+        db.close()
+
+    def test_loser_retries_onto_a_different_coin(self, tmp_path):
+        db, idx = _indexed(tmp_path)
+        idx.notify_all(_issue_stream(2, qty=lambda i: 100))
+        a = idx.select_coins("USD", 100, holder=b"flow-a")
+        b = idx.select_coins("USD", 100, holder=b"flow-b")
+        assert len(a) == 1 and len(b) == 1
+        assert a[0].ref != b[0].ref
+        db.close()
+
+    def test_ttl_expiry_readmits_the_coin(self, tmp_path):
+        _tm.arm()
+        db, idx = _indexed(tmp_path, softlock_ttl_s=0.02)
+        idx.notify_all([_SeedTx(_tx_hash(0), (_cash(100),))])
+        a = idx.select_coins("USD", 100, holder=b"crashed-flow")
+        assert len(a) == 1
+        time.sleep(0.05)
+        b = idx.select_coins("USD", 100, holder=b"flow-b")
+        assert [s.ref for s in b] == [s.ref for s in a]
+        assert _tm.ACTIVE.counter(
+            "vault_softlock_expired_total").value >= 1
+        db.close()
+
+    def test_consumption_releases_the_lock(self, tmp_path):
+        db, idx = _indexed(tmp_path)
+        idx.notify_all([_SeedTx(_tx_hash(0), (_cash(100),))])
+        (coin,) = idx.select_coins("USD", 100, holder=b"flow-a")
+        idx.notify_all([_SeedTx(_tx_hash(1), (), inputs=(coin.ref,))])
+        assert len(idx.softlocks) == 0
+        db.close()
+
+    def test_insufficient_funds_releases_partial_reservation(self, tmp_path):
+        db, idx = _indexed(tmp_path)
+        idx.notify_all([_SeedTx(_tx_hash(0), (_cash(100),))])
+        got = idx.select_coins("USD", 500, holder=b"flow-a")
+        assert len(got) == 1  # the partial set, for the asset's error path
+        assert len(idx.softlocks) == 0  # but nothing stays shadowed
+        db.close()
+
+    def test_concurrent_selection_never_double_selects(self, tmp_path):
+        db, idx = _indexed(tmp_path)
+        idx.notify_all(_issue_stream(8, qty=lambda i: 100))
+        picked, errors = [], []
+
+        def worker(name):
+            try:
+                picked.append((name,
+                               idx.select_coins("USD", 100, holder=name)))
+            except Exception as e:  # surfaced below; threads must not die
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker,
+                                    args=(b"flow-%d" % i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        refs = [c.ref for _name, coins in picked for c in coins]
+        assert len(refs) == len(set(refs)) == 8  # exactly-once, all served
+        db.close()
+
+    def test_softlock_manager_relock_refreshes_own_ttl(self):
+        locks = SoftLockManager(ttl_s=10.0)
+        ref = StateRef(_tx_hash(0), 0)
+        assert locks.try_lock(ref, b"a", now=0.0)
+        assert not locks.try_lock(ref, b"b", now=1.0)
+        assert locks.try_lock(ref, b"a", now=9.0)  # refresh
+        assert not locks.try_lock(ref, b"b", now=15.0)  # still held
+        assert locks.try_lock(ref, b"b", now=25.0)  # expired
+
+
+# ---------------------------------------------------------------------------
+# Keyset pagination under concurrent consumption
+# ---------------------------------------------------------------------------
+
+
+def test_keyset_pagination_stable_under_consumption(tmp_path):
+    db, idx = _indexed(tmp_path)
+    idx.notify_all(_issue_stream(60))
+    first = idx.query(VaultQuery(page_size=20))
+    seen = [s.ref for s in first.states]
+    # Consume states BOTH behind the cursor (already paged) and ahead of
+    # it: an OFFSET pager would shift and either skip or repeat rows.
+    behind = seen[:5]
+    ordered = sorted((s.ref for s in idx.iter_unconsumed()),
+                     key=lambda r: (r.txhash.bytes, r.index))
+    ahead = [r for r in ordered if r not in set(seen)][:5]
+    idx.notify_all([_SeedTx(_tx_hash(200), (),
+                            inputs=tuple(behind + ahead))])
+    cursor = first.next_cursor
+    while cursor is not None:
+        page = idx.query(VaultQuery(after=cursor, page_size=20))
+        seen.extend(s.ref for s in page.states)
+        cursor = page.next_cursor
+    assert len(seen) == len(set(seen))  # no duplicates despite churn
+    # Every state is accounted for: paged, or consumed ahead of paging.
+    assert set(seen) | set(ahead) == {
+        StateRef(_tx_hash(i), 0) for i in range(60)}
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# Watermark incremental boot
+# ---------------------------------------------------------------------------
+
+
+class TestIncrementalBoot:
+    def _ledger(self, db, n, start=0):
+        txs = [_SeedTx(_tx_hash(i), (_cash(100 + i),))
+               for i in range(start, n)]
+        with db.lock:
+            db.conn.executemany(
+                "INSERT INTO transactions (tx_id, blob) VALUES (?, ?)",
+                ((stx.id.bytes, b"") for stx in txs))
+            db.commit()
+        return txs
+
+    def test_restart_replays_only_the_delta(self, tmp_path):
+        db = NodeDatabase(tmp_path / "boot.db")
+        txs = self._ledger(db, 20)
+        vault = IndexedVaultService(db, _our_keys)
+        assert vault.rebuild_from(_SeedStorage(txs), batch=8) == 20
+        assert vault.watermark == 20
+        # New transactions land while the vault engine is "down".
+        txs += self._ledger(db, 25, start=20)
+        reborn = IndexedVaultService(db, _our_keys)
+        assert reborn.rebuild_from(_SeedStorage(txs), batch=8) == 5
+        assert reborn.watermark == 25
+        assert len(reborn) == 25
+        # A current store replays nothing at all.
+        assert IndexedVaultService(db, _our_keys).rebuild_from(
+            _SeedStorage(txs)) == 0
+        db.close()
+
+    def test_crash_replay_is_idempotent_and_silent(self, tmp_path):
+        """Re-folding already-applied transactions (the crash-between-
+        watermark-batches shape) must not double-count balances or
+        re-fire observers."""
+        db = NodeDatabase(tmp_path / "boot.db")
+        txs = self._ledger(db, 10)
+        vault = IndexedVaultService(db, _our_keys)
+        vault.rebuild_from(_SeedStorage(txs))
+        balances = vault.balances()
+        fired = []
+        vault.subscribe(lambda update: fired.append(update))
+        vault.notify_all(txs)  # the whole prefix again
+        assert vault.balances() == balances
+        assert fired == []
+        assert len(vault) == 10
+        db.close()
+
+    def test_spends_replay_cleanly_through_the_watermark(self, tmp_path):
+        db = NodeDatabase(tmp_path / "boot.db")
+        issues = self._ledger(db, 10)
+        spend = _SeedTx(_tx_hash(100), (_cash(1),),
+                        inputs=(StateRef(_tx_hash(0), 0),
+                                StateRef(_tx_hash(1), 0)))
+        with db.lock:
+            db.conn.execute(
+                "INSERT INTO transactions (tx_id, blob) VALUES (?, ?)",
+                (spend.id.bytes, b""))
+            db.commit()
+        txs = issues + [spend]
+        vault = IndexedVaultService(db, _our_keys)
+        vault.rebuild_from(_SeedStorage(txs))
+        assert vault.watermark == 11
+        assert len(vault) == 9  # 10 issued - 2 consumed + 1 change
+        expect = vault.balances()
+        reborn = IndexedVaultService(db, _our_keys)
+        assert reborn.rebuild_from(_SeedStorage(txs)) == 0
+        assert reborn.balances() == expect
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# Durability: bitrot becomes a repair event, never a wrong answer
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_vault_row_is_quarantined(tmp_path):
+    db, idx = _indexed(tmp_path)
+    idx.notify_all(_issue_stream(3))
+    with db.lock:
+        db.conn.execute(
+            "UPDATE vault_states SET blob = substr(blob, 2) "
+            "WHERE ref_txhash = ?", (_tx_hash(1).bytes,))
+        db.commit()
+    survivors = [s.ref for s in idx.unconsumed_states()]
+    assert StateRef(_tx_hash(1), 0) not in survivors
+    assert len(survivors) == 2
+    (n,) = db.conn.execute(
+        "SELECT COUNT(*) FROM quarantine WHERE kind = 'vault_state'"
+    ).fetchone()
+    assert n == 1
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# Config / node plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestConfigPlumbing:
+    def test_vault_config_defaults_and_parse(self):
+        assert VaultConfig().indexed is False
+        cfg = NodeConfig.from_dict({
+            "name": "V", "base_dir": "/tmp/v",
+            "vault": {"indexed": True, "softlock_ttl_s": 2.5,
+                      "rebuild_batch": 64}})
+        assert cfg.vault.indexed is True
+        assert cfg.vault.softlock_ttl_s == 2.5
+        assert cfg.vault.rebuild_batch == 64
+
+    def test_node_arms_indexed_engine_from_config(self, tmp_path):
+        from corda_tpu.node.node import Node
+        node = Node(NodeConfig(
+            name="Ix", base_dir=tmp_path / "Ix",
+            network_map=tmp_path / "netmap.json",
+            vault=VaultConfig(indexed=True))).start()
+        try:
+            assert isinstance(node.services.vault_service,
+                              IndexedVaultService)
+        finally:
+            node.stop()
+
+    def test_node_defaults_to_inmemory_engine(self, tmp_path):
+        from corda_tpu.node.node import Node
+        node = Node(NodeConfig(
+            name="Mem", base_dir=tmp_path / "Mem",
+            network_map=tmp_path / "netmap.json")).start()
+        try:
+            assert isinstance(node.services.vault_service,
+                              NodeVaultService)
+        finally:
+            node.stop()
+
+    def test_env_var_arms_indexed_engine(self, tmp_path, monkeypatch):
+        from corda_tpu.node.node import Node
+        monkeypatch.setenv("CORDA_TPU_VAULT_INDEXED", "1")
+        node = Node(NodeConfig(
+            name="Env", base_dir=tmp_path / "Env",
+            network_map=tmp_path / "netmap.json")).start()
+        try:
+            assert isinstance(node.services.vault_service,
+                              IndexedVaultService)
+        finally:
+            node.stop()
+
+    def test_indexed_vault_survives_restart(self, tmp_path):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_tcp_node import issue_and_move
+
+        from corda_tpu.node.node import Node
+        cfg = lambda: NodeConfig(  # noqa: E731
+            name="VX", base_dir=tmp_path / "VX",
+            network_map=tmp_path / "netmap.json",
+            vault=VaultConfig(indexed=True))
+        node = Node(cfg()).start()
+        stx = issue_and_move(node, node.identity, magic=5)
+        node.services.record_transactions([stx])
+        before = _snapshot(node.services.vault_service)
+        assert before
+        node.stop()
+        del node
+
+        reborn = Node(cfg()).start()
+        try:
+            assert _snapshot(reborn.services.vault_service) == before
+        finally:
+            reborn.stop()
+
+    def test_autotune_knob_resolves_and_overlays(self):
+        from corda_tpu.autotune import space
+        assert space.validate_registry() == []
+        assert space.overlay_for({"vault.indexed": 1}) == {
+            "vault": {"indexed": 1}}
+
+
+# ---------------------------------------------------------------------------
+# Doctor: the vault_scan rule and the vault_scaling gate keys
+# ---------------------------------------------------------------------------
+
+
+def _breakdown_artifact(vault_share, traces=40):
+    e2e = 100.0
+    return {
+        "metric": "verified_sigs_per_sec",
+        "baseline_configs": {
+            "raft_open_loop_latency": {
+                "stage_breakdown": {
+                    "traces": traces,
+                    "end_to_end": {"mean_ms": e2e},
+                    "stages": {
+                        "vault_query": {"mean_ms": e2e * vault_share},
+                        "verify_wait": {"mean_ms": 5.0},
+                    },
+                }}}}
+
+
+class TestDoctorVaultScan:
+    def test_rule_fires_on_dominant_vault_share(self):
+        signals = doctor.extract_signals(_breakdown_artifact(0.4))
+        assert signals["flow_stage_shares"]["vault_query"] == 0.4
+        verdict = doctor.diagnose(signals)
+        hit = next(b for b in verdict["bottlenecks"]
+                   if b["cause"] == "vault_scan")
+        assert hit["score"] == 0.7
+        assert hit["experiment"]["experiment_id"] == "arm_indexed_vault"
+        assert "vault.indexed" in hit["experiment"]["knobs"]
+        assert "indexed=true" in hit["next_experiment"]
+
+    def test_rule_abstains_below_threshold_share(self):
+        verdict = doctor.diagnose(
+            doctor.extract_signals(_breakdown_artifact(0.1)))
+        assert all(b["cause"] != "vault_scan"
+                   for b in verdict["bottlenecks"])
+
+    def test_rule_abstains_below_min_traces(self):
+        signals = doctor.extract_signals(_breakdown_artifact(0.9, traces=5))
+        assert "flow_stage_shares" not in signals
+
+    def test_gate_hoists_vault_metrics_and_fails_on_parity_flip(self):
+        def artifact(ratio, parity):
+            return {
+                "metric": "verified_sigs_per_sec",
+                "baseline_configs": {"vault_scaling": {
+                    "vault_coin_selection_p99_ratio": ratio,
+                    "vault_boot_speedup": 40.0,
+                    "vault_query_p99_ms": 12.0,
+                    "vault_parity_ok": parity,
+                }}}
+        prev = doctor.normalize_record(artifact(2.0, True), source="r22_a")
+        assert prev["metrics"]["vault_parity_ok"] is True
+        assert prev["metrics"]["vault_coin_selection_p99_ratio"] == 2.0
+        ok = doctor.gate([prev,
+                          doctor.normalize_record(artifact(2.1, True),
+                                                  source="r22_b")])
+        assert ok["ok"]
+        flipped = doctor.gate([prev,
+                               doctor.normalize_record(artifact(2.0, False),
+                                                       source="r22_c")])
+        assert not flipped["ok"]
+        assert any(r["metric"] == "vault_parity_ok"
+                   for r in flipped["regressions"])
+        regressed = doctor.gate([prev,
+                                 doctor.normalize_record(artifact(3.0, True),
+                                                         source="r22_d")])
+        assert not regressed["ok"]
+        assert any(r["metric"] == "vault_coin_selection_p99_ratio"
+                   for r in regressed["regressions"])
+
+
+# ---------------------------------------------------------------------------
+# The bench section, end to end at toy scale
+# ---------------------------------------------------------------------------
+
+
+def test_bench_vault_scaling_contract():
+    import bench
+    out = bench.bench_vault_scaling(sizes=(64, 256), queries=6,
+                                    selections=6, boot_batch=64,
+                                    parity_n=45)
+    assert out["vault_parity_ok"] is True
+    assert out["vault_boot_speedup"] > 1.0
+    assert out["boot"]["replayed_on_reopen"] == 0
+    assert set(out["per_size"]) == {"64_states", "256_states"}
+    for key in ("vault_coin_selection_p99_ratio", "vault_query_p99_ms",
+                "sublinear_ok"):
+        assert key in out
